@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU mesh so multi-chip sharding
+paths (parallel/) are exercised without TPU hardware.
+
+Must set XLA flags before jax initialises any backend, hence module-level
+os.environ mutation in conftest (imported before any test module).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

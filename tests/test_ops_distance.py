@@ -1,0 +1,104 @@
+"""Unit tests for ops/distance.py against numpy reference implementations.
+
+Mirrors the reference's exactness invariant: exact paths must match a
+trusted oracle to tight tolerance (reference: test/utils/vearch_utils.py:55
+assert_bit_wise_equal; here float tolerance since fp32 matmul reassociates).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from vearch_tpu.engine.types import MetricType
+from vearch_tpu.ops import distance as D
+
+
+def np_l2_sq(q, x):
+    return ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+
+
+def test_l2_scores_match_numpy(rng):
+    q = rng.standard_normal((7, 32), dtype=np.float32)
+    x = rng.standard_normal((100, 32), dtype=np.float32)
+    s = np.asarray(D.similarity_scores(jnp.asarray(q), jnp.asarray(x), MetricType.L2))
+    np.testing.assert_allclose(-s, np_l2_sq(q, x), rtol=1e-4, atol=1e-3)
+
+
+def test_ip_and_cosine_scores(rng):
+    q = rng.standard_normal((5, 16), dtype=np.float32)
+    x = rng.standard_normal((50, 16), dtype=np.float32)
+    s = np.asarray(
+        D.similarity_scores(jnp.asarray(q), jnp.asarray(x), MetricType.INNER_PRODUCT)
+    )
+    np.testing.assert_allclose(s, q @ x.T, rtol=1e-5, atol=1e-5)
+
+    s = np.asarray(
+        D.similarity_scores(jnp.asarray(q), jnp.asarray(x), MetricType.COSINE)
+    )
+    qc = q / np.linalg.norm(q, axis=1, keepdims=True)
+    xc = x / np.linalg.norm(x, axis=1, keepdims=True)
+    np.testing.assert_allclose(s, qc @ xc.T, rtol=1e-4, atol=1e-5)
+
+
+def test_precomputed_sqnorm_equivalent(rng):
+    q = rng.standard_normal((3, 8), dtype=np.float32)
+    x = rng.standard_normal((20, 8), dtype=np.float32)
+    s1 = D.similarity_scores(jnp.asarray(q), jnp.asarray(x), MetricType.L2)
+    s2 = D.similarity_scores(
+        jnp.asarray(q), jnp.asarray(x), MetricType.L2,
+        base_sqnorm=D.sqnorms(jnp.asarray(x)),
+    )
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+
+
+def test_masked_topk_excludes_invalid(rng):
+    scores = jnp.asarray(rng.standard_normal((2, 10), dtype=np.float32))
+    valid = jnp.asarray([True] * 5 + [False] * 5)
+    top_s, top_i = D.masked_topk(scores, valid, k=5)
+    assert np.asarray(top_i).max() < 5
+    # exact ordering matches numpy on the valid prefix
+    ref = np.argsort(-np.asarray(scores)[:, :5], axis=1)
+    np.testing.assert_array_equal(np.asarray(top_i), ref)
+
+
+def test_masked_topk_fewer_valid_than_k(rng):
+    scores = jnp.asarray(rng.standard_normal((1, 8), dtype=np.float32))
+    valid = jnp.asarray([True, True] + [False] * 6)
+    top_s, top_i = D.masked_topk(scores, valid, k=4)
+    s = np.asarray(top_s)[0]
+    assert np.isfinite(s[:2]).all() and np.isneginf(s[2:]).all()
+
+
+def test_topk_k_larger_than_n_pads(rng):
+    # fresh/small partitions may hold fewer docs than requested top-k
+    q = rng.standard_normal((2, 8), dtype=np.float32)
+    x = rng.standard_normal((3, 8), dtype=np.float32)
+    top_s, top_i = D.brute_force_search(jnp.asarray(q), jnp.asarray(x), None, 5)
+    assert top_s.shape == (2, 5) and top_i.shape == (2, 5)
+    assert np.isneginf(np.asarray(top_s)[:, 3:]).all()
+    assert (np.asarray(top_i)[:, 3:] == -1).all()
+
+
+def test_brute_force_search_exact(rng):
+    q = rng.standard_normal((4, 24), dtype=np.float32)
+    x = rng.standard_normal((200, 24), dtype=np.float32)
+    top_s, top_i = D.brute_force_search(
+        jnp.asarray(q), jnp.asarray(x), None, k=10, metric=MetricType.L2
+    )
+    ref_d = np_l2_sq(q, x)
+    ref_i = np.argsort(ref_d, axis=1)[:, :10]
+    np.testing.assert_array_equal(np.asarray(top_i), ref_i)
+    np.testing.assert_allclose(
+        -np.asarray(top_s), np.take_along_axis(ref_d, ref_i, axis=1),
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_merge_topk(rng):
+    # two shards each with local top-3; merged must equal global top-3
+    s1 = jnp.asarray([[3.0, 1.0, 0.5]])
+    i1 = jnp.asarray([[10, 11, 12]])
+    s2 = jnp.asarray([[2.0, 1.5, 0.1]])
+    i2 = jnp.asarray([[20, 21, 22]])
+    ms, mi = D.merge_topk([s1, s2], [i1, i2], k=3)
+    np.testing.assert_array_equal(np.asarray(mi), [[10, 20, 21]])
+    np.testing.assert_allclose(np.asarray(ms), [[3.0, 2.0, 1.5]])

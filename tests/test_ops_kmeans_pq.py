@@ -1,0 +1,75 @@
+"""Tests for k-means training and product quantization ops."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from vearch_tpu.ops import kmeans as km
+from vearch_tpu.ops import pq as pqm
+
+
+def _blobs(rng, n_per, k, d, spread=0.05):
+    centers = rng.standard_normal((k, d)).astype(np.float32) * 3
+    pts = np.concatenate(
+        [c + spread * rng.standard_normal((n_per, d)).astype(np.float32) for c in centers]
+    )
+    return pts, centers
+
+
+def test_kmeans_recovers_blobs(rng):
+    x, centers = _blobs(rng, n_per=50, k=8, d=16)
+    cents = np.asarray(km.train_kmeans(jnp.asarray(x), k=8, iters=15, chunk=128))
+    # every true center has a learned centroid nearby
+    d = np.linalg.norm(centers[:, None, :] - cents[None, :, :], axis=-1)
+    assert (d.min(axis=1) < 0.5).all()
+
+
+def test_kmeans_no_nan_with_k_gt_clusters(rng):
+    # more centroids than natural clusters -> empty clusters must reseed, not NaN
+    x, _ = _blobs(rng, n_per=30, k=3, d=8)
+    cents = np.asarray(km.train_kmeans(jnp.asarray(x), k=16, iters=8, chunk=64))
+    assert np.isfinite(cents).all()
+
+
+def test_assign_clusters_matches_numpy(rng):
+    x = rng.standard_normal((257, 12)).astype(np.float32)
+    c = rng.standard_normal((9, 12)).astype(np.float32)
+    got = np.asarray(km.assign_clusters(jnp.asarray(x), jnp.asarray(c), chunk=64))
+    ref = np.argmin(((x[:, None] - c[None]) ** 2).sum(-1), axis=1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pq_roundtrip_reduces_error(rng):
+    x, _ = _blobs(rng, n_per=100, k=8, d=32, spread=0.1)
+    cb = pqm.train_pq(jnp.asarray(x), m=4, ksub=16, iters=10)
+    codes = pqm.encode_pq(jnp.asarray(x), cb)
+    assert codes.shape == (800, 4) and codes.dtype == jnp.uint8
+    recon = np.asarray(pqm.decode_pq(codes, cb))
+    err = np.linalg.norm(recon - x, axis=1).mean()
+    base = np.linalg.norm(x - x.mean(0), axis=1).mean()
+    assert err < 0.35 * base  # quantization must beat the trivial centroid
+
+
+def test_adc_scores_match_decoded_l2(rng):
+    x = rng.standard_normal((300, 16)).astype(np.float32)
+    q = rng.standard_normal((5, 16)).astype(np.float32)
+    cb = pqm.train_pq(jnp.asarray(x), m=4, ksub=32, iters=8)
+    codes = pqm.encode_pq(jnp.asarray(x), cb)
+    lut = pqm.adc_lut_l2(jnp.asarray(q), cb)
+    adc = np.asarray(pqm.adc_scores(lut, codes))
+    recon = np.asarray(pqm.decode_pq(codes, cb))
+    ref = ((q[:, None] - recon[None]) ** 2).sum(-1)
+    np.testing.assert_allclose(adc, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_adc_scores_per_query_candidates(rng):
+    x = rng.standard_normal((100, 8)).astype(np.float32)
+    q = rng.standard_normal((3, 8)).astype(np.float32)
+    cb = pqm.train_pq(jnp.asarray(x), m=2, ksub=16, iters=6)
+    codes = pqm.encode_pq(jnp.asarray(x), cb)
+    cand = jnp.asarray(np.stack([np.arange(10), np.arange(10, 20), np.arange(20, 30)]))
+    per_q_codes = codes[cand]  # [3, 10, 2]
+    lut = pqm.adc_lut_l2(jnp.asarray(q), cb)
+    got = np.asarray(pqm.adc_scores(lut, per_q_codes))
+    full = np.asarray(pqm.adc_scores(lut, codes))
+    ref = np.take_along_axis(full, np.asarray(cand), axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)

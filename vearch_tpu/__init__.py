@@ -1,0 +1,28 @@
+"""vearch-tpu: a TPU-native distributed vector database.
+
+A ground-up re-design of the capabilities of vearch/vearch (reference:
+master/router/partition-server cluster, raft-replicated partitions, hybrid
+vector + scalar-filter search, realtime ingest, pluggable ANN indexes) where
+the dense vector math — distance, IVF coarse assignment, PQ ADC, top-k —
+runs as jit'd, sharded JAX/XLA programs on TPU.
+
+Layering (mirrors reference SURVEY.md §1, re-architected TPU-first):
+
+    cluster/   master / router / partition-server, metastore, replication
+    engine/    per-partition engine: table, raw vectors, deletion bitmap
+    index/     pluggable index registry (FLAT, IVFFLAT, IVFPQ, ...)
+    scalar/    scalar indexes + filter planning (inverted, bitmap, composite)
+    ops/       jit'd TPU kernels: distance, top-k, k-means, PQ
+    parallel/  device mesh, sharded search, multi-chip top-k merge
+"""
+
+__version__ = "0.1.0"
+
+from vearch_tpu.engine.types import (  # noqa: F401
+    DataType,
+    FieldSchema,
+    IndexParams,
+    IndexStatus,
+    MetricType,
+    TableSchema,
+)

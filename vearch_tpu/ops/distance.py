@@ -1,0 +1,147 @@
+"""Batch distance kernels, MXU-first.
+
+TPU-native replacement for the reference's faiss SIMD distance loops
+(reference: internal/engine/index/impl/gamma_index_flat.cc brute-force scan,
+faiss distances). Everything is expressed as one big matmul so XLA tiles it
+onto the MXU:
+
+    L2:   d(q, x)^2 = ||q||^2 - 2 q.x + ||x||^2
+    IP:   s(q, x)   = q.x
+    COS:  s(q, x)   = (q/||q||) . (x/||x||)
+
+Scores are returned in "similarity" orientation — HIGHER is always better —
+so `lax.top_k` applies uniformly. `score_to_metric` converts back to the
+user-facing metric value (L2 distance is `-score`).
+
+Matmuls accumulate in float32 (`preferred_element_type`); inputs may be
+bfloat16 for 2x HBM bandwidth (the usual bottleneck for brute-force scan).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from vearch_tpu.engine.types import MetricType
+
+# Plain float, not a jnp scalar: a module-level jnp value would initialise
+# the XLA backend at import time and pin the platform before the app
+# configures it.
+NEG_INF = float("-inf")
+
+
+def sqnorms(x: jax.Array) -> jax.Array:
+    """Row-wise squared L2 norms, accumulated in f32. Shape [n]."""
+    xf = x.astype(jnp.float32)
+    return jnp.sum(xf * xf, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def similarity_scores(
+    queries: jax.Array,
+    base: jax.Array,
+    metric: MetricType = MetricType.L2,
+    base_sqnorm: jax.Array | None = None,
+) -> jax.Array:
+    """Dense [B, N] similarity matrix (higher = better).
+
+    queries: [B, d]; base: [N, d]; base_sqnorm: optional precomputed [N]
+    (cached per segment by the raw-vector store so the hot path reads the
+    base matrix exactly once).
+    """
+    dots = jax.lax.dot_general(
+        queries,
+        base,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [B, N]
+    if metric is MetricType.INNER_PRODUCT:
+        return dots
+    if metric is MetricType.COSINE:
+        qn = jnp.sqrt(jnp.maximum(sqnorms(queries), 1e-30))[:, None]
+        if base_sqnorm is None:
+            base_sqnorm = sqnorms(base)
+        bn = jnp.sqrt(jnp.maximum(base_sqnorm, 1e-30))[None, :]
+        return dots / (qn * bn)
+    # L2: score = -(||q||^2 - 2 q.x + ||x||^2)
+    if base_sqnorm is None:
+        base_sqnorm = sqnorms(base)
+    qn = sqnorms(queries)
+    d2 = qn[:, None] - 2.0 * dots + base_sqnorm[None, :]
+    return -jnp.maximum(d2, 0.0)
+
+
+def score_to_metric(scores: jax.Array, metric: MetricType) -> jax.Array:
+    """Convert internal similarity scores to user-facing metric values."""
+    if metric is MetricType.L2:
+        return -scores
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_topk(
+    scores: jax.Array, valid: jax.Array | None, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k over [B, N] scores with an optional [N] or [B, N] validity mask.
+
+    Invalid slots (deleted docs — reference: util/bitmap_manager.h:19 —
+    padding rows, or scalar-filtered docs) score -inf and sink to the
+    bottom. Returns (scores [B, k], indices [B, k]); callers must drop
+    hits whose score is -inf when fewer than k valid docs exist. When
+    k > N the result is padded with (-inf, -1) columns so the output
+    shape is always [B, k] (a fresh partition may hold fewer docs than
+    the requested top-k).
+    """
+    if valid is not None:
+        if valid.ndim == 1:
+            valid = valid[None, :]
+        scores = jnp.where(valid, scores, NEG_INF)
+    n = scores.shape[-1]
+    if k <= n:
+        return jax.lax.top_k(scores, k)
+    top_s, top_i = jax.lax.top_k(scores, n)
+    pad = ((0, 0),) * (scores.ndim - 1) + ((0, k - n),)
+    return (
+        jnp.pad(top_s, pad, constant_values=-jnp.inf),
+        jnp.pad(top_i, pad, constant_values=-1),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "k"))
+def brute_force_search(
+    queries: jax.Array,
+    base: jax.Array,
+    valid: jax.Array | None,
+    k: int,
+    metric: MetricType = MetricType.L2,
+    base_sqnorm: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused exact search: distance matmul + masked top-k.
+
+    The engine's brute-force path, used by the FLAT index and as the
+    below-training-threshold fallback (reference: engine.cc:280-302).
+    """
+    scores = similarity_scores(queries, base, metric, base_sqnorm)
+    return masked_topk(scores, valid, k)
+
+
+def merge_topk(
+    scores_list: list[jax.Array],
+    ids_list: list[jax.Array],
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Merge per-segment / per-shard top-k candidate lists into a global
+    top-k (reference: router-side sorted merge, client/client.go:779).
+
+    scores_list: list of [B, k_i] similarity scores; ids_list: matching
+    global doc ids. Concatenate + re-top-k — O(B * sum k_i) and fully
+    on-device, no host round-trip.
+    """
+    scores = jnp.concatenate(scores_list, axis=1)
+    ids = jnp.concatenate(ids_list, axis=1)
+    k = min(k, scores.shape[1])
+    top_scores, pos = jax.lax.top_k(scores, k)
+    return top_scores, jnp.take_along_axis(ids, pos, axis=1)

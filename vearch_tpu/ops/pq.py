@@ -1,0 +1,122 @@
+"""Product quantization: codebook training, encoding, ADC lookup.
+
+TPU-native replacement for faiss ProductQuantizer as used by the reference's
+IVFPQ index (reference: index/impl/gamma_index_ivfpq.h:1258 GammaIVFPQIndex).
+
+Layout choices for TPU:
+- codebooks: [m, ksub, dsub] f32 — trained by a vmap'd k-means (all m
+  subquantizers train in one compiled program);
+- codes: [n, m] uint8 — 16-32x HBM traffic reduction vs raw f32 vectors,
+  which is the entire point on a bandwidth-bound chip;
+- ADC: per-query lookup tables [B, m, ksub], scores via take_along_axis
+  gather + sum over m. XLA lowers the gather to dynamic-slice-friendly
+  code; candidate sets come from IVF probing so n_candidates stays in the
+  tens of thousands, keeping the gather cheap relative to the LUT matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from vearch_tpu.ops import kmeans as km
+from vearch_tpu.ops.distance import sqnorms
+
+
+def train_pq(
+    x: jax.Array, m: int, ksub: int = 256, iters: int = 10, seed: int = 0
+) -> jax.Array:
+    """Train m subquantizer codebooks on x [n, d]; returns [m, ksub, dsub].
+
+    vmap over subspaces: one XLA program trains all m codebooks.
+    """
+    n, d = x.shape
+    assert d % m == 0, f"dim {d} not divisible by m={m}"
+    assert 2 <= ksub <= 256, f"ksub={ksub} must fit uint8 codes"
+    dsub = d // m
+    sub = jnp.moveaxis(x.reshape(n, m, dsub), 1, 0)  # [m, n, dsub]
+    train = functools.partial(km.train_kmeans, k=ksub, iters=iters, seed=seed)
+    return jax.vmap(train)(sub)
+
+
+@jax.jit
+def encode_pq(x: jax.Array, codebooks: jax.Array) -> jax.Array:
+    """Encode x [n, d] -> codes [n, m] uint8."""
+    n, d = x.shape
+    m, ksub, dsub = codebooks.shape
+    assert ksub <= 256, f"ksub={ksub} would wrap around in uint8 codes"
+    sub = jnp.moveaxis(x.reshape(n, m, dsub), 1, 0)  # [m, n, dsub]
+    assign = jax.vmap(km.assign_clusters)(sub, codebooks)  # [m, n]
+    return assign.T.astype(jnp.uint8)
+
+
+@jax.jit
+def decode_pq(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
+    """Reconstruct [n, d] from codes [n, m] (for rerank / tests)."""
+    m, ksub, dsub = codebooks.shape
+    picked = jnp.take_along_axis(
+        codebooks[None],  # [1, m, ksub, dsub]
+        codes.astype(jnp.int32)[:, :, None, None],  # [n, m, 1, 1]
+        axis=2,
+    )  # [n, m, 1, dsub]
+    return picked.reshape(codes.shape[0], m * dsub)
+
+
+@jax.jit
+def adc_lut_l2(queries: jax.Array, codebooks: jax.Array) -> jax.Array:
+    """Squared-L2 lookup tables [B, m, ksub] for ADC.
+
+    lut[b, j, c] = || q_b[sub j] - codebooks[j, c] ||^2, computed as a
+    batched matmul over subspaces (MXU) + norms.
+    """
+    b, d = queries.shape
+    m, ksub, dsub = codebooks.shape
+    qsub = jnp.moveaxis(queries.reshape(b, m, dsub), 1, 0)  # [m, b, dsub]
+    dots = jax.lax.dot_general(
+        qsub.astype(jnp.float32), codebooks.astype(jnp.float32),
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [m, b, ksub]
+    q_sq = sqnorms(qsub)  # [m, b]
+    c_sq = sqnorms(codebooks)  # [m, ksub]
+    lut = q_sq[:, :, None] - 2.0 * dots + c_sq[:, None, :]
+    return jnp.moveaxis(lut, 0, 1)  # [B, m, ksub]
+
+
+@jax.jit
+def adc_lut_ip(queries: jax.Array, codebooks: jax.Array) -> jax.Array:
+    """Inner-product lookup tables [B, m, ksub] (higher = better)."""
+    b, d = queries.shape
+    m, ksub, dsub = codebooks.shape
+    qsub = jnp.moveaxis(queries.reshape(b, m, dsub), 1, 0)
+    dots = jax.lax.dot_general(
+        qsub.astype(jnp.float32), codebooks.astype(jnp.float32),
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return jnp.moveaxis(dots, 0, 1)
+
+
+@jax.jit
+def adc_scores(lut: jax.Array, codes: jax.Array) -> jax.Array:
+    """ADC distances from per-query LUTs.
+
+    lut: [B, m, ksub]; codes: [..., m] uint8 — either [N, m] (shared
+    candidate set) or [B, N, m] (per-query candidates from IVF probing).
+    Returns [B, N] summed table values in the LUT's own orientation:
+    L2 distances (lower = better) for `adc_lut_l2`, raw inner products
+    (higher = better) for `adc_lut_ip`.
+    """
+    c = codes.astype(jnp.int32)
+    if c.ndim == 2:
+        c = c[None]  # shared candidate set broadcasts over queries
+    picked = jnp.take_along_axis(
+        lut[:, None, :, :],  # [B, 1, m, ksub]
+        c[:, :, :, None],  # [B|1, N, m, 1]
+        axis=3,
+    )[..., 0]
+    return jnp.sum(picked, axis=-1)  # [B, N]
