@@ -1,0 +1,189 @@
+"""Engine core e2e: upsert → search → update → delete → dump/load.
+
+Models the reference's engine-level gtest coverage
+(reference: internal/engine/tests/test_gamma_index.cc engine E2E).
+"""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType,
+    FieldSchema,
+    IndexParams,
+    MetricType,
+    TableSchema,
+)
+
+
+def make_schema(d=16, index_type="FLAT", metric=MetricType.L2, params=None):
+    return TableSchema(
+        name="ts",
+        fields=[
+            FieldSchema("title", DataType.STRING),
+            FieldSchema("price", DataType.FLOAT),
+            FieldSchema(
+                "emb",
+                DataType.VECTOR,
+                dimension=d,
+                index=IndexParams(index_type=index_type, metric_type=metric,
+                                  params=params or {}),
+            ),
+        ],
+    )
+
+
+@pytest.fixture
+def engine_with_docs(rng):
+    eng = Engine(make_schema())
+    vecs = rng.standard_normal((50, 16), dtype=np.float32)
+    docs = [
+        {"_id": f"doc{i}", "title": f"t{i}", "price": float(i), "emb": vecs[i]}
+        for i in range(50)
+    ]
+    eng.upsert(docs)
+    return eng, vecs
+
+
+def test_upsert_and_exact_search(engine_with_docs):
+    eng, vecs = engine_with_docs
+    assert eng.doc_count == 50
+    res = eng.search(SearchRequest(vectors={"emb": vecs[7]}, k=3))
+    assert res[0].items[0].key == "doc7"
+    assert res[0].items[0].score == pytest.approx(0.0, abs=1e-3)
+    assert res[0].items[0].fields["title"] == "t7"
+
+
+def test_update_replaces_old_row(engine_with_docs, rng):
+    eng, vecs = engine_with_docs
+    new_vec = rng.standard_normal(16).astype(np.float32)
+    eng.upsert([{"_id": "doc7", "title": "updated", "price": 1.5, "emb": new_vec}])
+    assert eng.doc_count == 50  # update, not insert
+    res = eng.search(SearchRequest(vectors={"emb": new_vec}, k=1))
+    assert res[0].items[0].key == "doc7"
+    assert res[0].items[0].fields["title"] == "updated"
+    # old vector must no longer be findable under doc7
+    res = eng.search(SearchRequest(vectors={"emb": vecs[7]}, k=50))
+    keys = [it.key for it in res[0].items]
+    assert keys.count("doc7") <= 1
+
+
+def test_delete_masks_doc(engine_with_docs):
+    eng, vecs = engine_with_docs
+    assert eng.delete(["doc7"]) == 1
+    assert eng.doc_count == 49
+    res = eng.search(SearchRequest(vectors={"emb": vecs[7]}, k=5))
+    assert all(it.key != "doc7" for it in res[0].items)
+    assert eng.get(["doc7"]) == []
+    # idempotent delete
+    assert eng.delete(["doc7"]) == 0
+
+
+def test_get_returns_fields_and_vector(engine_with_docs):
+    eng, vecs = engine_with_docs
+    docs = eng.get(["doc3"])
+    assert docs[0]["_id"] == "doc3"
+    assert docs[0]["price"] == 3.0
+    np.testing.assert_allclose(docs[0]["emb"], vecs[3], rtol=1e-6)
+
+
+def test_batch_search_multiple_queries(engine_with_docs):
+    eng, vecs = engine_with_docs
+    res = eng.search(SearchRequest(vectors={"emb": vecs[:5]}, k=1))
+    assert [r.items[0].key for r in res] == [f"doc{i}" for i in range(5)]
+
+
+def test_ip_metric_ranking(rng):
+    eng = Engine(make_schema(metric=MetricType.INNER_PRODUCT))
+    vecs = rng.standard_normal((20, 16), dtype=np.float32)
+    eng.upsert(
+        [{"_id": f"d{i}", "title": "", "price": 0.0, "emb": vecs[i]} for i in range(20)]
+    )
+    q = rng.standard_normal(16).astype(np.float32)
+    res = eng.search(SearchRequest(vectors={"emb": q}, k=20))
+    scores = [it.score for it in res[0].items]
+    assert scores == sorted(scores, reverse=True)  # IP: higher first
+    ref = np.argsort(-(vecs @ q))
+    assert [it.key for it in res[0].items] == [f"d{i}" for i in ref]
+
+
+def test_auto_generated_ids(rng):
+    eng = Engine(make_schema())
+    keys = eng.upsert(
+        [{"title": "x", "price": 0.0, "emb": rng.standard_normal(16)}]
+    )
+    assert len(keys) == 1 and len(keys[0]) == 32  # uuid hex
+
+
+def test_dump_load_roundtrip(engine_with_docs, tmp_path):
+    eng, vecs = engine_with_docs
+    eng.delete(["doc5"])
+    eng.dump(str(tmp_path / "p0"))
+    eng2 = Engine.open(str(tmp_path / "p0"))
+    assert eng2.doc_count == 49
+    res = eng2.search(SearchRequest(vectors={"emb": vecs[8]}, k=2))
+    assert res[0].items[0].key == "doc8"
+    assert all(it.key != "doc5"
+               for r in eng2.search(SearchRequest(vectors={"emb": vecs[5]}, k=49))
+               for it in r.items)
+
+
+def test_falsy_id_is_respected(rng):
+    eng = Engine(make_schema())
+    v = rng.standard_normal(16).astype(np.float32)
+    eng.upsert([{"_id": 0, "title": "a", "price": 0.0, "emb": v}])
+    eng.upsert([{"_id": 0, "title": "b", "price": 0.0, "emb": v}])
+    assert eng.doc_count == 1  # second call is an update, not a new uuid doc
+    assert eng.get(["0"])[0]["title"] == "b"
+
+
+def test_dump_empty_engine_then_write(tmp_path, rng):
+    eng = Engine(make_schema())
+    eng.dump(str(tmp_path / "empty"))
+    eng2 = Engine.open(str(tmp_path / "empty"))
+    eng2.upsert([{"_id": "x", "title": "", "price": 0.0,
+                  "emb": rng.standard_normal(16)}])
+    assert eng2.doc_count == 1
+
+
+def test_mixed_metric_multi_field_rejected(rng):
+    schema = TableSchema(
+        name="mm",
+        fields=[
+            FieldSchema("a", DataType.VECTOR, dimension=8,
+                        index=IndexParams("FLAT", MetricType.L2)),
+            FieldSchema("b", DataType.VECTOR, dimension=8,
+                        index=IndexParams("FLAT", MetricType.INNER_PRODUCT)),
+        ],
+    )
+    eng = Engine(schema)
+    eng.upsert([{"_id": "d", "a": np.zeros(8), "b": np.zeros(8)}])
+    q = np.zeros(8, dtype=np.float32)
+    with pytest.raises(ValueError, match="single metric"):
+        eng.search(SearchRequest(vectors={"a": q, "b": q}, k=1))
+
+
+def test_multi_vector_field_weighted_merge(rng):
+    schema = TableSchema(
+        name="mv",
+        fields=[
+            FieldSchema("a", DataType.VECTOR, dimension=8,
+                        index=IndexParams("FLAT", MetricType.INNER_PRODUCT)),
+            FieldSchema("b", DataType.VECTOR, dimension=8,
+                        index=IndexParams("FLAT", MetricType.INNER_PRODUCT)),
+        ],
+    )
+    eng = Engine(schema)
+    va = rng.standard_normal((10, 8), dtype=np.float32)
+    vb = rng.standard_normal((10, 8), dtype=np.float32)
+    eng.upsert([{"_id": f"d{i}", "a": va[i], "b": vb[i]} for i in range(10)])
+    q = rng.standard_normal(8).astype(np.float32)
+    res = eng.search(
+        SearchRequest(vectors={"a": q, "b": q}, k=10,
+                      field_weights={"a": 0.3, "b": 0.7})
+    )
+    got = {it.key: it.score for it in res[0].items}
+    ref = 0.3 * (va @ q) + 0.7 * (vb @ q)
+    for i in range(10):
+        assert got[f"d{i}"] == pytest.approx(float(ref[i]), abs=1e-4)

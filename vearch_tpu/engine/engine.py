@@ -1,0 +1,378 @@
+"""Per-partition engine: orchestrates table + vector stores + indexes +
+deletion bitmap.
+
+TPU-native re-design of the reference's gamma Engine (reference:
+internal/engine/search/engine.h:35 `vearch::Engine`; search entry
+engine.cc:242, upsert engine.cc:691, brute-force fallback engine.cc:280-302,
+background build engine.cc:966/1106). One Engine instance per partition;
+the cluster layer (ps) holds a registry of them.
+
+Write model (TPU-first): everything is append-only. An update soft-deletes
+the old docid and appends a new row, so device vector buffers never mutate
+rows — deletions are masked inside the top-k kernel. Compaction is an
+offline rebuild (rebuild_index), as in the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from vearch_tpu.engine.bitmap import BitmapManager
+from vearch_tpu.engine.raw_vector import RawVectorStore
+from vearch_tpu.engine.table import Table
+from vearch_tpu.engine.types import (
+    IndexParams,
+    IndexStatus,
+    SearchResult,
+    SearchResultItem,
+    TableSchema,
+)
+from vearch_tpu.index.base import VectorIndex
+from vearch_tpu.index.registry import create_index
+
+
+@dataclass
+class SearchRequest:
+    """One batched vector search (reference: api_data/request.h:18).
+
+    vectors: field name -> [B, d] query matrix. Multiple fields are merged
+    with `field_weights` (reference: WeightedRanker, doc_query.go:202).
+    filters: a scalar-filter AST (vearch_tpu.scalar.filter) or None.
+    """
+
+    vectors: dict[str, np.ndarray]
+    k: int = 10
+    filters: Any = None
+    include_fields: list[str] | None = None
+    brute_force: bool = False  # force exact scan even when indexed
+    field_weights: dict[str, float] = field(default_factory=dict)
+
+
+class Engine:
+    def __init__(self, schema: TableSchema, data_dir: str | None = None):
+        self.schema = schema
+        self.data_dir = data_dir
+        self.table = Table(schema)
+        self.bitmap = BitmapManager()
+        self.vector_stores: dict[str, RawVectorStore] = {}
+        self.indexes: dict[str, VectorIndex] = {}
+        self.status = IndexStatus.UNINDEXED
+        self._write_lock = threading.Lock()
+        self._scalar_manager = None  # attached by scalar.manager when built
+
+        for f in schema.vector_fields():
+            params = f.index or IndexParams()
+            dtype = params.get("store_dtype", "float32")
+            store = RawVectorStore(f.dimension, store_dtype=dtype)
+            self.vector_stores[f.name] = store
+            self.indexes[f.name] = create_index(params, store)
+
+    # -- writes --------------------------------------------------------------
+
+    def upsert(self, docs: list[dict[str, Any]]) -> list[str]:
+        """Add-or-update a batch; returns assigned doc keys.
+
+        Mirrors reference engine.cc:691 AddOrUpdate: existing key ==
+        update -> old docid soft-deleted, new row appended everywhere.
+        """
+        vf = self.schema.vector_fields()
+        keys: list[str] = []
+        with self._write_lock:
+            # batch the vector appends: one host copy per field per call
+            mats = {
+                f.name: np.asarray(
+                    [d[f.name] for d in docs], dtype=np.float32
+                ).reshape(len(docs), f.dimension)
+                for f in vf
+            }
+            for i, doc in enumerate(docs):
+                key = str(doc["_id"]) if "_id" in doc else uuid.uuid4().hex
+                fields = {k: v for k, v in doc.items() if k != "_id"}
+                docid, old = self.table.add(key, fields)
+                if old is not None:
+                    self.bitmap.set_deleted(old)
+                keys.append(key)
+            for f in vf:
+                self.vector_stores[f.name].add(mats[f.name])
+            if self._scalar_manager is not None:
+                self._scalar_manager.add_docs(docs, len(self.table._keys) - len(docs))
+        self._maybe_start_build()
+        return keys
+
+    def delete(self, keys: list[str]) -> int:
+        n = 0
+        with self._write_lock:
+            for key in keys:
+                docid = self.table.delete(key)
+                if docid is not None:
+                    self.bitmap.set_deleted(docid)
+                    n += 1
+        return n
+
+    def get(self, keys: list[str], fields: list[str] | None = None) -> list[dict]:
+        out = []
+        for key in keys:
+            docid = self.table.docid_of(key)
+            if docid is None or self.bitmap.is_deleted(docid):
+                continue
+            doc = {"_id": key, **self.table.get_fields(docid, fields)}
+            for name, store in self.vector_stores.items():
+                if fields is None or name in fields:
+                    doc[name] = store.get(docid).tolist()
+            out.append(doc)
+        return out
+
+    @property
+    def doc_count(self) -> int:
+        """Alive docs (reference: engine status doc_num minus deletes)."""
+        return self.table.doc_count - self.bitmap.deleted_count
+
+    # -- index lifecycle -----------------------------------------------------
+
+    def _maybe_start_build(self) -> None:
+        """Kick off a background train+absorb once the training threshold is
+        crossed (reference: the Indexing thread trains when doc volume
+        passes training_threshold, engine.cc:1106). CAS-style guard mirrors
+        the reference's IDLE->STARTING state machine (engine.cc:967)."""
+        needs = [
+            (name, idx)
+            for name, idx in self.indexes.items()
+            if idx.needs_training
+            and not idx.trained
+            and self.vector_stores[name].count >= self._training_threshold(idx)
+        ]
+        if not needs or self.status != IndexStatus.UNINDEXED:
+            return
+        self.status = IndexStatus.TRAINING
+        t = threading.Thread(target=self.build_index, daemon=True)
+        t.start()
+        self._build_thread = t
+
+    def wait_for_index(self, timeout: float | None = None) -> None:
+        """Join an in-flight background build (tests / explicit flush)."""
+        t = getattr(self, "_build_thread", None)
+        if t is not None:
+            t.join(timeout)
+
+    def build_index(self, field_name: str | None = None) -> None:
+        """Train + absorb all current rows (reference: engine.cc:966
+        BuildIndex -> Indexing thread; here synchronous — the cluster
+        layer wraps it in a background thread)."""
+        self.status = IndexStatus.TRAINING
+        for name, index in self.indexes.items():
+            if field_name is not None and name != field_name:
+                continue
+            store = self.vector_stores[name]
+            if index.needs_training and not index.trained:
+                index.train(store.host_view())
+            index.absorb(store.count)
+        self.status = IndexStatus.INDEXED
+
+    def rebuild_index(self) -> None:
+        """Retrain from scratch (reference: engine.cc:1007 RebuildIndex)."""
+        for name, index in self.indexes.items():
+            params = index.params
+            store = self.vector_stores[name]
+            self.indexes[name] = create_index(params, store)
+        self.status = IndexStatus.UNINDEXED
+        self.build_index()
+
+    def _training_threshold(self, index: VectorIndex) -> int:
+        """Docs required before auto-build starts; explicit build_index()
+        ignores it (reference: /index/forcemerge trains immediately)."""
+        return int(
+            index.params.get(
+                "training_threshold", self.schema.training_threshold or 100_000
+            )
+        )
+
+    # -- search --------------------------------------------------------------
+
+    def search(self, req: SearchRequest) -> list[SearchResult]:
+        if not req.vectors:
+            raise ValueError("search needs at least one vector field")
+        n = self.table.doc_count
+        valid = self.bitmap.valid_mask(n)
+        if req.filters is not None:
+            from vearch_tpu.scalar.filter import evaluate_filter
+
+            valid = valid & evaluate_filter(req.filters, self, n)
+
+        metrics = {self.indexes[name].metric for name in req.vectors}
+        if len(metrics) > 1:
+            raise ValueError(
+                "multi-field search requires a single metric across fields; "
+                f"got {[m.value for m in metrics]}"
+            )
+
+        per_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        queries_by_field: dict[str, np.ndarray] = {}
+        fetch_k = req.k if len(req.vectors) == 1 else max(req.k * 4, 50)
+        for name, queries in req.vectors.items():
+            queries = np.asarray(queries, dtype=np.float32)
+            if queries.ndim == 1:
+                queries = queries[None, :]
+            queries_by_field[name] = queries
+            index = self.indexes[name]
+            store = self.vector_stores[name]
+            use_index = index.trained and not req.brute_force
+            if use_index:
+                if index.indexed_count < store.count:
+                    # realtime pump: absorb rows that arrived since the
+                    # last pass (reference: AddRTVecsToIndex)
+                    index.absorb(store.count)
+                scores, ids = index.search(queries, fetch_k, valid)
+            else:
+                # brute-force fallback below training threshold
+                # (reference: engine.cc:280-302)
+                from vearch_tpu.index.flat import FlatIndex
+
+                flat = FlatIndex(
+                    IndexParams(metric_type=index.metric), store
+                )
+                scores, ids = flat.search(queries, fetch_k, valid)
+            per_field[name] = (scores, ids)
+
+        merged = self._merge_fields(per_field, queries_by_field, req)
+        return self._shape_results(merged, req)
+
+    def _exact_score(
+        self, name: str, query: np.ndarray, docids: list[int]
+    ) -> np.ndarray:
+        """Host-side exact similarity scores for a small candidate set
+        (union rescoring in the multi-field merge)."""
+        from vearch_tpu.engine.types import MetricType
+
+        store = self.vector_stores[name]
+        vecs = np.stack([store.get(i) for i in docids])
+        metric = self.indexes[name].metric
+        dots = vecs @ query
+        if metric is MetricType.INNER_PRODUCT:
+            return dots
+        if metric is MetricType.COSINE:
+            qn = max(float(np.linalg.norm(query)), 1e-15)
+            vn = np.maximum(np.linalg.norm(vecs, axis=1), 1e-15)
+            return dots / (qn * vn)
+        return -(np.sum((vecs - query) ** 2, axis=1))
+
+    def _merge_fields(
+        self,
+        per_field: dict[str, tuple[np.ndarray, np.ndarray]],
+        queries_by_field: dict[str, np.ndarray],
+        req: SearchRequest,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Multi-vector-field rank merge with weights (reference:
+        vector_manager.cc:748 docid-sorted merge + WeightedRanker).
+
+        Candidates = union of per-field top lists; every candidate is then
+        rescored *exactly* in every field, so a doc missing from one
+        field's truncated list still gets its true weighted score."""
+        if len(per_field) == 1:
+            return next(iter(per_field.values()))
+        names = list(per_field)
+        b = per_field[names[0]][0].shape[0]
+        out_scores = []
+        out_ids = []
+        for qi in range(b):
+            union: set[int] = set()
+            for name in names:
+                _, ids = per_field[name]
+                scores = per_field[name][0]
+                union.update(
+                    int(i)
+                    for s, i in zip(scores[qi], ids[qi])
+                    if i >= 0 and np.isfinite(s)
+                )
+            cand = sorted(union)
+            if not cand:
+                out_ids.append([-1] * req.k)
+                out_scores.append([float("-inf")] * req.k)
+                continue
+            total = np.zeros(len(cand), dtype=np.float64)
+            for name in names:
+                w = req.field_weights.get(name, 1.0)
+                total += w * self._exact_score(
+                    name, queries_by_field[name][qi], cand
+                )
+            order = np.argsort(-total)[: req.k]
+            ids_row = [cand[i] for i in order]
+            sc_row = [float(total[i]) for i in order]
+            pad = req.k - len(ids_row)
+            out_ids.append(ids_row + [-1] * pad)
+            out_scores.append(sc_row + [float("-inf")] * pad)
+        return np.asarray(out_scores), np.asarray(out_ids)
+
+    def _shape_results(
+        self, merged: tuple[np.ndarray, np.ndarray], req: SearchRequest
+    ) -> list[SearchResult]:
+        from vearch_tpu.ops.distance import score_to_metric
+
+        scores, ids = merged
+        metric = self.indexes[next(iter(req.vectors))].metric
+        results = []
+        for qi in range(scores.shape[0]):
+            items = []
+            for s, i in zip(scores[qi][: req.k], ids[qi][: req.k]):
+                i = int(i)
+                if i < 0 or not np.isfinite(s):
+                    continue
+                key = self.table.key_of(i)
+                fields = (
+                    self.table.get_fields(i, req.include_fields)
+                    if req.include_fields is None or req.include_fields
+                    else {}
+                )
+                metric_score = float(
+                    np.asarray(score_to_metric(np.float32(s), metric))
+                )
+                items.append(SearchResultItem(key=key, score=metric_score, fields=fields))
+            results.append(SearchResult(items=items))
+        return results
+
+    # -- persistence (reference: engine.cc:1217 Dump / :1293 Load) ----------
+
+    def dump(self, dirpath: str | None = None) -> None:
+        dirpath = dirpath or self.data_dir
+        assert dirpath, "no data_dir configured"
+        os.makedirs(dirpath, exist_ok=True)
+        with open(os.path.join(dirpath, "schema.json"), "w") as f:
+            json.dump(self.schema.to_dict(), f)
+        self.table.dump(os.path.join(dirpath, "table"))
+        self.bitmap.dump(os.path.join(dirpath, "bitmap.npy"))
+        for name, store in self.vector_stores.items():
+            store.dump(os.path.join(dirpath, f"vectors_{name}.npy"))
+        for name, index in self.indexes.items():
+            state = index.dump_state()
+            if state:
+                np.savez(os.path.join(dirpath, f"index_{name}.npz"), **state)
+        with open(os.path.join(dirpath, "engine.json"), "w") as f:
+            json.dump({"status": int(self.status)}, f)
+
+    def load(self, dirpath: str | None = None) -> None:
+        dirpath = dirpath or self.data_dir
+        assert dirpath and os.path.exists(dirpath), f"no dump at {dirpath}"
+        self.table.load(os.path.join(dirpath, "table"))
+        self.bitmap.load(os.path.join(dirpath, "bitmap.npy"))
+        for name, store in self.vector_stores.items():
+            store.load(os.path.join(dirpath, f"vectors_{name}.npy"))
+        for name, index in self.indexes.items():
+            p = os.path.join(dirpath, f"index_{name}.npz")
+            if os.path.exists(p):
+                index.load_state(dict(np.load(p, allow_pickle=False)))
+        with open(os.path.join(dirpath, "engine.json")) as f:
+            self.status = IndexStatus(json.load(f)["status"])
+
+    @classmethod
+    def open(cls, dirpath: str) -> "Engine":
+        with open(os.path.join(dirpath, "schema.json")) as f:
+            schema = TableSchema.from_dict(json.load(f))
+        eng = cls(schema, data_dir=dirpath)
+        eng.load(dirpath)
+        return eng

@@ -1,0 +1,151 @@
+"""Document profile store: key -> docid mapping + columnar scalar fields.
+
+TPU-native re-design of the reference's Table (reference:
+internal/engine/table/table.h:34 — key→docid map plus fixed/string field
+column families in RocksDB). Here scalar columns are typed numpy arrays
+(fixed-width types) or python lists (strings), append-only with docid as
+the row index; updates of an existing key soft-delete the old row and
+append a new one, which keeps every downstream structure — device vector
+buffers, scalar indexes — append-only too.
+
+Persistence: one .npz for fixed columns + a JSON sidecar for strings/keys
+(Engine.dump drives it; reference: table/table_io.cc).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator
+
+import numpy as np
+
+from vearch_tpu.engine.types import DataType, TableSchema
+
+_FIXED_DTYPES: dict[DataType, np.dtype] = {
+    DataType.INT: np.dtype(np.int32),
+    DataType.LONG: np.dtype(np.int64),
+    DataType.FLOAT: np.dtype(np.float32),
+    DataType.DOUBLE: np.dtype(np.float64),
+    DataType.DATE: np.dtype(np.int64),  # epoch millis
+    DataType.BOOL: np.dtype(np.bool_),
+}
+
+
+class _Column:
+    """Append-only typed column with amortised growth."""
+
+    def __init__(self, dtype: np.dtype):
+        self.dtype = dtype
+        self._data = np.zeros(1024, dtype=dtype)
+        self._n = 0
+
+    def append(self, value: Any) -> None:
+        if self._n >= self._data.shape[0]:
+            grown = np.zeros(max(self._data.shape[0] * 2, 1024), dtype=self.dtype)
+            grown[: self._n] = self._data[: self._n]
+            self._data = grown
+        self._data[self._n] = value if value is not None else 0
+        self._n += 1
+
+    def view(self) -> np.ndarray:
+        return self._data[: self._n]
+
+    def __getitem__(self, docid: int) -> Any:
+        return self._data[docid]
+
+
+class Table:
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self._key_to_docid: dict[str, int] = {}
+        self._keys: list[str] = []  # docid -> key
+        self._fixed: dict[str, _Column] = {}
+        self._strings: dict[str, list[Any]] = {}
+        for f in schema.scalar_fields():
+            if f.data_type in _FIXED_DTYPES:
+                self._fixed[f.name] = _Column(_FIXED_DTYPES[f.data_type])
+            else:
+                self._strings[f.name] = []
+
+    @property
+    def doc_count(self) -> int:
+        """High-water docid count (includes soft-deleted rows)."""
+        return len(self._keys)
+
+    def docid_of(self, key: str) -> int | None:
+        return self._key_to_docid.get(key)
+
+    def key_of(self, docid: int) -> str:
+        return self._keys[docid]
+
+    def add(self, key: str, fields: dict[str, Any]) -> tuple[int, int | None]:
+        """Append a row; returns (new_docid, replaced_docid_or_None).
+
+        An existing key is an update: the caller soft-deletes the old docid
+        (reference: engine.cc:691 AddOrUpdate key-exists branch).
+        """
+        old = self._key_to_docid.get(key)
+        docid = len(self._keys)
+        self._keys.append(key)
+        self._key_to_docid[key] = docid
+        for name, col in self._fixed.items():
+            col.append(fields.get(name))
+        for name, lst in self._strings.items():
+            lst.append(fields.get(name))
+        return docid, old
+
+    def delete(self, key: str) -> int | None:
+        """Remove the key mapping; returns the docid to soft-delete."""
+        return self._key_to_docid.pop(key, None)
+
+    def get_fields(
+        self, docid: int, names: list[str] | None = None
+    ) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for name, col in self._fixed.items():
+            if names is None or name in names:
+                out[name] = col[docid].item()
+        for name, lst in self._strings.items():
+            if names is None or name in names:
+                out[name] = lst[docid]
+        return out
+
+    def column(self, name: str) -> np.ndarray:
+        """Columnar view of a fixed-width field (for scalar index builds /
+        filter evaluation). Raises KeyError for string fields."""
+        return self._fixed[name].view()
+
+    def string_column(self, name: str) -> list[Any]:
+        return self._strings[name]
+
+    def iter_alive(self) -> Iterator[tuple[str, int]]:
+        yield from self._key_to_docid.items()
+
+    # -- persistence ---------------------------------------------------------
+
+    def dump(self, dirpath: str) -> None:
+        os.makedirs(dirpath, exist_ok=True)
+        np.savez(
+            os.path.join(dirpath, "columns.npz"),
+            **{name: col.view() for name, col in self._fixed.items()},
+        )
+        meta = {
+            "keys": self._keys,
+            "key_to_docid": self._key_to_docid,
+            "strings": self._strings,
+        }
+        with open(os.path.join(dirpath, "table.json"), "w") as f:
+            json.dump(meta, f)
+
+    def load(self, dirpath: str) -> None:
+        with open(os.path.join(dirpath, "table.json")) as f:
+            meta = json.load(f)
+        self._keys = meta["keys"]
+        self._key_to_docid = {k: int(v) for k, v in meta["key_to_docid"].items()}
+        self._strings = meta["strings"]
+        data = np.load(os.path.join(dirpath, "columns.npz"))
+        for name, col in self._fixed.items():
+            arr = data[name]
+            col._data = arr.copy()
+            col._n = arr.shape[0]

@@ -1,0 +1,72 @@
+"""Pluggable vector index framework.
+
+TPU-native re-design of the reference's IndexModel ABC + Reflector registry
+(reference: internal/engine/index/index_model.h:236 `IndexModel`,
+reflector.h:26,67 `REGISTER_INDEX`). The reference's GPU index types
+(index/impl/gpu/) are the precedent: an accelerator backend behind the same
+plugin seam. Here every index runs its dense math as jit'd JAX programs.
+
+Contract differences from the reference, driven by TPU semantics:
+- `add` is append-only with docid == row id; updates/deletes are handled
+  by the engine's soft-delete bitmap, indexes never mutate rows in place;
+- `search` takes a host validity mask (deletions + scalar filter) and must
+  apply it *inside* the kernel (masked top-k), not post-filter, so k valid
+  results survive;
+- `train`/`build` may be called from a background thread (reference:
+  engine.cc:1106 Indexing loop); implementations keep host-side state
+  swaps atomic (build new arrays, then publish by reference assignment).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+import numpy as np
+
+from vearch_tpu.engine.raw_vector import RawVectorStore
+from vearch_tpu.engine.types import IndexParams, MetricType
+
+
+class VectorIndex(abc.ABC):
+    """Base class for all vector index types."""
+
+    #: whether train() must run before the index can serve (IVF family)
+    needs_training: bool = False
+
+    def __init__(self, params: IndexParams, store: RawVectorStore):
+        self.params = params
+        self.store = store
+        self.metric: MetricType = params.metric_type
+        self.trained = not self.needs_training
+        self.indexed_count = 0  # rows absorbed into the index structure
+
+    @abc.abstractmethod
+    def search(
+        self, queries: np.ndarray, k: int, valid_mask: np.ndarray | None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Batch search. queries [B, d] f32; valid_mask [n] bool or None.
+
+        Returns (scores [B, k] similarity-oriented (higher=better),
+        docids [B, k] int; -1 and -inf pad missing results).
+        """
+
+    def train(self, sample: np.ndarray) -> None:
+        """Train quantizers on a sample (no-op for non-trained indexes)."""
+        self.trained = True
+
+    def absorb(self, upto: int) -> None:
+        """Absorb raw-vector rows [indexed_count, upto) into the index
+        structure (realtime ingest pump; reference: vector_manager.h:76
+        AddRTVecsToIndex). FLAT-style indexes that search the raw store
+        directly just advance the counter."""
+        self.indexed_count = upto
+
+    # -- persistence (index-specific state only; raw vectors are dumped by
+    #    the engine — reference: index is rebuildable, vectors are durable)
+
+    def dump_state(self) -> dict[str, Any]:
+        return {}
+
+    def load_state(self, state: dict[str, Any]) -> None:
+        pass

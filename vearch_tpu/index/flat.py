@@ -1,0 +1,51 @@
+"""FLAT (exact brute-force) index.
+
+TPU-native re-design of the reference's FLAT index (reference:
+index/impl/gamma_index_flat.cc:183) — there a SIMD-dispatched scan, here
+one MXU matmul over the device-resident raw-vector buffer + masked top-k.
+Exact by construction; no training; results match numpy to fp32 tolerance
+(the reference's exactness invariant, test/utils/vearch_utils.py:55).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vearch_tpu.engine.raw_vector import RawVectorStore
+from vearch_tpu.engine.types import IndexParams
+from vearch_tpu.index.base import VectorIndex
+from vearch_tpu.index.registry import register_index
+from vearch_tpu.ops.distance import brute_force_search
+
+
+@register_index("FLAT")
+class FlatIndex(VectorIndex):
+    needs_training = False
+
+    def __init__(self, params: IndexParams, store: RawVectorStore):
+        super().__init__(params, store)
+
+    def search(
+        self, queries: np.ndarray, k: int, valid_mask: np.ndarray | None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        base, base_sqnorm, n = self.store.device_buffer()
+        cap = base.shape[0]
+        # mask = alive rows; padding rows beyond n are always invalid
+        mask = np.zeros(cap, dtype=bool)
+        if valid_mask is not None:
+            mask[:n] = valid_mask[:n]
+        else:
+            mask[:n] = True
+        scores, ids = brute_force_search(
+            jnp.asarray(queries, dtype=base.dtype),
+            base,
+            jnp.asarray(mask),
+            k,
+            self.metric,
+            base_sqnorm,
+        )
+        # single batched D2H fetch: device->host latency dominates small
+        # results, so never fetch scores and ids separately
+        return jax.device_get((scores, ids))

@@ -1,0 +1,41 @@
+"""Index type registry (reference: reflector.h:67 `REGISTER_INDEX` macro +
+index_factory). Index modules self-register at import; `create_index` is
+the engine's only entry point, so new index types plug in without touching
+engine code — the same seam the reference uses for its GPU backends."""
+
+from __future__ import annotations
+
+from typing import Callable, Type
+
+from vearch_tpu.engine.raw_vector import RawVectorStore
+from vearch_tpu.engine.types import IndexParams
+from vearch_tpu.index.base import VectorIndex
+
+_REGISTRY: dict[str, Type[VectorIndex]] = {}
+
+
+def register_index(name: str) -> Callable[[Type[VectorIndex]], Type[VectorIndex]]:
+    def deco(cls: Type[VectorIndex]) -> Type[VectorIndex]:
+        _REGISTRY[name.upper()] = cls
+        return cls
+
+    return deco
+
+
+def create_index(params: IndexParams, store: RawVectorStore) -> VectorIndex:
+    name = params.index_type.upper()
+    if name not in _REGISTRY:
+        # import built-ins lazily so registration is a side effect of use
+        import vearch_tpu.index.builtin  # noqa: F401
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown index_type {params.index_type!r}; "
+            f"registered: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](params, store)
+
+
+def registered_types() -> list[str]:
+    import vearch_tpu.index.builtin  # noqa: F401
+
+    return sorted(_REGISTRY)
