@@ -21,6 +21,7 @@ class BitmapManager:
     def __init__(self, capacity: int = 1024):
         self._bits = np.zeros(max(1, capacity), dtype=bool)  # True = deleted
         self._deleted_count = 0
+        self.version = 0  # bumped on every mutation (device-mask cache key)
 
     def _ensure(self, docid: int) -> None:
         if docid >= self._bits.shape[0]:
@@ -34,12 +35,14 @@ class BitmapManager:
         if not self._bits[docid]:
             self._bits[docid] = True
             self._deleted_count += 1
+            self.version += 1
 
     def unset(self, docid: int) -> None:
         self._ensure(docid)
         if self._bits[docid]:
             self._bits[docid] = False
             self._deleted_count -= 1
+            self.version += 1
 
     def is_deleted(self, docid: int) -> bool:
         return docid < self._bits.shape[0] and bool(self._bits[docid])
@@ -60,3 +63,4 @@ class BitmapManager:
         if os.path.exists(path):
             self._bits = np.load(path)
             self._deleted_count = int(self._bits.sum())
+            self.version += 1

@@ -53,6 +53,7 @@ class SearchRequest:
     include_fields: list[str] | None = None
     brute_force: bool = False  # force exact scan even when indexed
     field_weights: dict[str, float] = field(default_factory=dict)
+    index_params: dict[str, Any] = field(default_factory=dict)  # nprobe etc.
 
 
 class Engine:
@@ -166,13 +167,20 @@ class Engine:
         BuildIndex -> Indexing thread; here synchronous — the cluster
         layer wraps it in a background thread)."""
         self.status = IndexStatus.TRAINING
-        for name, index in self.indexes.items():
-            if field_name is not None and name != field_name:
-                continue
-            store = self.vector_stores[name]
-            if index.needs_training and not index.trained:
-                index.train(store.host_view())
-            index.absorb(store.count)
+        try:
+            for name, index in self.indexes.items():
+                if field_name is not None and name != field_name:
+                    continue
+                store = self.vector_stores[name]
+                if index.needs_training and not index.trained:
+                    index.train(store.host_view())
+                index.absorb(store.count)
+        except Exception as e:
+            # a failed (possibly background) build must not wedge the
+            # engine in TRAINING: record, reset, keep serving brute-force
+            self.last_build_error = e
+            self.status = IndexStatus.UNINDEXED
+            raise
         self.status = IndexStatus.INDEXED
 
     def rebuild_index(self) -> None:
@@ -195,15 +203,29 @@ class Engine:
 
     # -- search --------------------------------------------------------------
 
+    def _device_alive_mask(self, n: int):
+        import jax.numpy as jnp
+
+        key = (self.bitmap.version, n)
+        if getattr(self, "_mask_cache_key", None) != key:
+            self._mask_cache = jnp.asarray(self.bitmap.valid_mask(n))
+            self._mask_cache_key = key
+        return self._mask_cache
+
     def search(self, req: SearchRequest) -> list[SearchResult]:
         if not req.vectors:
             raise ValueError("search needs at least one vector field")
         n = self.table.doc_count
-        valid = self.bitmap.valid_mask(n)
         if req.filters is not None:
             from vearch_tpu.scalar.filter import evaluate_filter
 
-            valid = valid & evaluate_filter(req.filters, self, n)
+            valid = self.bitmap.valid_mask(n) & evaluate_filter(
+                req.filters, self, n
+            )
+        else:
+            # no filter -> the alive mask only changes on writes; keep it
+            # device-resident so the hot path skips a [n]-bool H2D upload
+            valid = self._device_alive_mask(n)
 
         metrics = {self.indexes[name].metric for name in req.vectors}
         if len(metrics) > 1:
@@ -228,7 +250,9 @@ class Engine:
                     # realtime pump: absorb rows that arrived since the
                     # last pass (reference: AddRTVecsToIndex)
                     index.absorb(store.count)
-                scores, ids = index.search(queries, fetch_k, valid)
+                scores, ids = index.search(
+                    queries, fetch_k, valid, req.index_params or None
+                )
             else:
                 # brute-force fallback below training threshold
                 # (reference: engine.cc:280-302)
@@ -316,23 +340,28 @@ class Engine:
 
         scores, ids = merged
         metric = self.indexes[next(iter(req.vectors))].metric
+        # vectorised conversion once per batch, not per item
+        metric_scores = np.asarray(score_to_metric(np.asarray(scores), metric))
+        want_fields = req.include_fields is None or bool(req.include_fields)
         results = []
         for qi in range(scores.shape[0]):
             items = []
-            for s, i in zip(scores[qi][: req.k], ids[qi][: req.k]):
-                i = int(i)
-                if i < 0 or not np.isfinite(s):
+            for col in range(min(req.k, scores.shape[1])):
+                i = int(ids[qi, col])
+                if i < 0 or not np.isfinite(scores[qi, col]):
                     continue
-                key = self.table.key_of(i)
                 fields = (
                     self.table.get_fields(i, req.include_fields)
-                    if req.include_fields is None or req.include_fields
+                    if want_fields
                     else {}
                 )
-                metric_score = float(
-                    np.asarray(score_to_metric(np.float32(s), metric))
+                items.append(
+                    SearchResultItem(
+                        key=self.table.key_of(i),
+                        score=float(metric_scores[qi, col]),
+                        fields=fields,
+                    )
                 )
-                items.append(SearchResultItem(key=key, score=metric_score, fields=fields))
             results.append(SearchResult(items=items))
         return results
 

@@ -20,6 +20,7 @@ Contract differences from the reference, driven by TPU semantics:
 from __future__ import annotations
 
 import abc
+import threading
 from typing import Any
 
 import numpy as np
@@ -40,12 +41,23 @@ class VectorIndex(abc.ABC):
         self.metric: MetricType = params.metric_type
         self.trained = not self.needs_training
         self.indexed_count = 0  # rows absorbed into the index structure
+        # serialises concurrent absorb() from search threads / the
+        # background build thread (reference: engine.cc CAS state machine)
+        self._absorb_lock = threading.Lock()
 
     @abc.abstractmethod
     def search(
-        self, queries: np.ndarray, k: int, valid_mask: np.ndarray | None
+        self,
+        queries: np.ndarray,
+        k: int,
+        valid_mask: np.ndarray | None,
+        params: dict | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Batch search. queries [B, d] f32; valid_mask [n] bool or None.
+
+        `params` carries per-request overrides (nprobe, rerank, ...) —
+        the reference's request-level index_params (doc_query.go
+        index_params riding each search request).
 
         Returns (scores [B, k] similarity-oriented (higher=better),
         docids [B, k] int; -1 and -inf pad missing results).

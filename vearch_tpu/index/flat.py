@@ -17,7 +17,7 @@ from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.engine.types import IndexParams
 from vearch_tpu.index.base import VectorIndex
 from vearch_tpu.index.registry import register_index
-from vearch_tpu.ops.distance import brute_force_search
+from vearch_tpu.ops.distance import brute_force_search, to_device_mask
 
 
 @register_index("FLAT")
@@ -28,20 +28,19 @@ class FlatIndex(VectorIndex):
         super().__init__(params, store)
 
     def search(
-        self, queries: np.ndarray, k: int, valid_mask: np.ndarray | None
+        self,
+        queries: np.ndarray,
+        k: int,
+        valid_mask: np.ndarray | None,
+        params: dict | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         base, base_sqnorm, n = self.store.device_buffer()
         cap = base.shape[0]
-        # mask = alive rows; padding rows beyond n are always invalid
-        mask = np.zeros(cap, dtype=bool)
-        if valid_mask is not None:
-            mask[:n] = valid_mask[:n]
-        else:
-            mask[:n] = True
+        mask = to_device_mask(valid_mask, n, cap)
         scores, ids = brute_force_search(
             jnp.asarray(queries, dtype=base.dtype),
             base,
-            jnp.asarray(mask),
+            mask,
             k,
             self.metric,
             base_sqnorm,

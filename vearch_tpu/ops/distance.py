@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from vearch_tpu.engine.types import MetricType
 
@@ -36,6 +37,42 @@ def sqnorms(x: jax.Array) -> jax.Array:
     """Row-wise squared L2 norms, accumulated in f32. Shape [n]."""
     xf = x.astype(jnp.float32)
     return jnp.sum(xf * xf, axis=-1)
+
+
+def dot_precision(*arrays: jax.Array):
+    """Pick matmul precision by input dtype.
+
+    float32 inputs get HIGHEST: the default truncates to bf16-ish passes
+    (~2e-3 rel err) and breaks the exactness invariant. Quantized inputs
+    (bf16/int8) are already single-MXU-pass exact, and HIGHEST on them
+    triggers a multi-pass f32 emulation measured 20x slower at 1M scale —
+    so they get DEFAULT.
+    """
+    if any(a.dtype == jnp.float32 or a.dtype == jnp.float64 for a in arrays):
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT
+
+
+def to_device_mask(valid_mask, n: int, cap: int) -> jax.Array:
+    """Normalise a validity mask to a device bool array of length `cap`.
+
+    `valid_mask` may be a host numpy array (per-request filter result),
+    an engine-cached `jax.Array` of length n, or None (all alive). Rows
+    in [n, cap) are padding and always False. Padding to the *capacity*
+    of the backing buffer (not the live count) keeps kernel input shapes
+    stable across ingest so jit doesn't retrace on every write.
+    """
+    if isinstance(valid_mask, jax.Array):
+        m = valid_mask[:n]
+        if m.shape[0] < cap:
+            m = jnp.pad(m, (0, cap - m.shape[0]))
+        return m
+    v = np.zeros(cap, dtype=np.bool_)
+    if valid_mask is not None:
+        v[:n] = valid_mask[:n]
+    else:
+        v[:n] = True
+    return jnp.asarray(v)
 
 
 @functools.partial(jax.jit, static_argnames=("metric",))
@@ -56,7 +93,7 @@ def similarity_scores(
         base,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=dot_precision(queries, base),
     )  # [B, N]
     if metric is MetricType.INNER_PRODUCT:
         return dots
