@@ -1,0 +1,142 @@
+"""Hybrid scalar-filter + vector search tests (reference:
+test/test_module_filter.py — range/term filters combined with knn)."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType,
+    FieldSchema,
+    IndexParams,
+    MetricType,
+    ScalarIndexType,
+    TableSchema,
+)
+from vearch_tpu.scalar.filter import Condition, Filter
+
+D = 16
+
+
+def make_engine(rng, scalar_index=ScalarIndexType.NONE, n=200):
+    schema = TableSchema(
+        name="f",
+        fields=[
+            FieldSchema("price", DataType.FLOAT, scalar_index=scalar_index),
+            FieldSchema("cat", DataType.STRING, scalar_index=scalar_index),
+            FieldSchema("stock", DataType.INT),
+            FieldSchema("emb", DataType.VECTOR, dimension=D,
+                        index=IndexParams("FLAT", MetricType.L2)),
+        ],
+    )
+    eng = Engine(schema)
+    vecs = rng.standard_normal((n, D)).astype(np.float32)
+    docs = [
+        {"_id": f"d{i}", "price": float(i % 50), "cat": f"c{i % 5}",
+         "stock": i % 10, "emb": vecs[i]}
+        for i in range(n)
+    ]
+    eng.upsert(docs)
+    return eng, vecs
+
+
+FILTER_CASES = [
+    ({"operator": "AND",
+      "conditions": [{"field": "price", "operator": ">=", "value": 10},
+                     {"field": "price", "operator": "<", "value": 20}]},
+     lambda i: 10 <= (i % 50) < 20),
+    ({"operator": "AND",
+      "conditions": [{"field": "cat", "operator": "IN", "value": ["c1", "c3"]}]},
+     lambda i: i % 5 in (1, 3)),
+    ({"operator": "OR",
+      "conditions": [{"field": "price", "operator": "=", "value": 7},
+                     {"field": "cat", "operator": "=", "value": "c2"}]},
+     lambda i: (i % 50) == 7 or i % 5 == 2),
+    ({"operator": "AND",
+      "conditions": [{"field": "cat", "operator": "NOT IN", "value": ["c0"]},
+                     {"field": "stock", "operator": "!=", "value": 3}]},
+     lambda i: i % 5 != 0 and i % 10 != 3),
+]
+
+
+@pytest.mark.parametrize("scalar_index", [
+    ScalarIndexType.NONE, ScalarIndexType.INVERTED, ScalarIndexType.BITMAP,
+])
+@pytest.mark.parametrize("case", range(len(FILTER_CASES)))
+def test_filtered_search_matches_predicate(rng, scalar_index, case):
+    flt, pred = FILTER_CASES[case]
+    eng, vecs = make_engine(rng, scalar_index)
+    res = eng.search(SearchRequest(vectors={"emb": vecs[:4]}, k=200, filters=flt))
+    expect = {f"d{i}" for i in range(200) if pred(i)}
+    for r in res:
+        got = {it.key for it in r.items}
+        assert got == expect
+
+
+def test_filter_self_query_top1(rng):
+    eng, vecs = make_engine(rng)
+    flt = {"operator": "AND",
+           "conditions": [{"field": "cat", "operator": "IN", "value": ["c3"]}]}
+    res = eng.search(SearchRequest(vectors={"emb": vecs[3]}, k=1, filters=flt))
+    assert res[0].items[0].key == "d3"
+
+
+def test_filter_excludes_top_hit(rng):
+    eng, vecs = make_engine(rng)
+    flt = {"operator": "AND",
+           "conditions": [{"field": "cat", "operator": "NOT IN", "value": ["c3"]}]}
+    res = eng.search(SearchRequest(vectors={"emb": vecs[3]}, k=5, filters=flt))
+    assert all(it.key != "d3" for it in res[0].items)
+
+
+def test_filter_with_deletes(rng):
+    eng, vecs = make_engine(rng)
+    eng.delete(["d7"])
+    flt = {"operator": "AND",
+           "conditions": [{"field": "price", "operator": "=", "value": 7.0}]}
+    res = eng.search(SearchRequest(vectors={"emb": vecs[7]}, k=50, filters=flt))
+    keys = {it.key for it in res[0].items}
+    assert "d7" not in keys
+    assert keys == {f"d{i}" for i in range(200) if i % 50 == 7 and i != 7}
+
+
+def test_filter_on_ivf_index(rng):
+    schema = TableSchema(
+        name="fi",
+        fields=[
+            FieldSchema("price", DataType.FLOAT),
+            FieldSchema("emb", DataType.VECTOR, dimension=D,
+                        index=IndexParams("IVFFLAT", MetricType.L2,
+                                          {"ncentroids": 16, "nprobe": 16})),
+        ],
+    )
+    eng = Engine(schema)
+    vecs = rng.standard_normal((2000, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "price": float(i % 100), "emb": vecs[i]}
+                for i in range(2000)])
+    eng.build_index()
+    flt = {"operator": "AND",
+           "conditions": [{"field": "price", "operator": "<", "value": 50}]}
+    res = eng.search(SearchRequest(vectors={"emb": vecs[:8]}, k=10, filters=flt))
+    for qi, r in enumerate(res):
+        assert all(int(it.key[1:]) % 100 < 50 for it in r.items)
+        if qi % 100 < 50:  # query's own row passes the filter
+            assert r.items[0].key == f"d{qi}"
+
+
+def test_invalid_operator_rejected():
+    with pytest.raises(ValueError, match="unsupported filter operator"):
+        Condition("price", "LIKE", "x")
+    with pytest.raises(ValueError, match="unsupported filter combinator"):
+        Filter(operator="XOR")
+
+
+def test_scalar_index_survives_dump_load(rng, tmp_path):
+    eng, vecs = make_engine(rng, ScalarIndexType.INVERTED)
+    eng.dump(str(tmp_path / "s"))
+    eng2 = Engine.open(str(tmp_path / "s"))
+    flt = {"operator": "AND",
+           "conditions": [{"field": "price", "operator": "=", "value": 5.0}]}
+    res = eng2.search(SearchRequest(vectors={"emb": vecs[:1]}, k=200, filters=flt))
+    assert {it.key for it in res[0].items} == \
+        {f"d{i}" for i in range(200) if i % 50 == 5}

@@ -66,7 +66,13 @@ class Engine:
         self.indexes: dict[str, VectorIndex] = {}
         self.status = IndexStatus.UNINDEXED
         self._write_lock = threading.Lock()
-        self._scalar_manager = None  # attached by scalar.manager when built
+        self._scalar_manager = None
+        if any(
+            f.scalar_index.value != "NONE" for f in schema.scalar_fields()
+        ):
+            from vearch_tpu.scalar.manager import ScalarIndexManager
+
+            self._scalar_manager = ScalarIndexManager(schema)
 
         for f in schema.vector_fields():
             params = f.index or IndexParams()
@@ -397,6 +403,8 @@ class Engine:
                 index.load_state(dict(np.load(p, allow_pickle=False)))
         with open(os.path.join(dirpath, "engine.json")) as f:
             self.status = IndexStatus(json.load(f)["status"])
+        if self._scalar_manager is not None:
+            self._scalar_manager.rebuild_from_table(self.table)
 
     @classmethod
     def open(cls, dirpath: str) -> "Engine":

@@ -1,0 +1,136 @@
+"""Scalar index implementations.
+
+TPU-native re-design of the reference's scalar index family (reference:
+internal/engine/table/scalar_index.h:28 `ScalarIndex` ABC;
+inverted_index.h:24 RocksDB (field,value,docid) keys with range scan;
+bitmap_index.h:23 roaring bitmaps). RocksDB key scans become sorted numpy
+arrays with `searchsorted` range slicing; roaring bitmaps become packed
+numpy bool arrays — both produce the docid masks the search kernel consumes
+directly.
+
+All indexes are append-only over docids (updates soft-delete the old row,
+so stale entries are masked by the deletion bitmap downstream — no index
+maintenance on delete, same as the vector side).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from vearch_tpu.scalar.filter import Condition, _eval_fixed
+
+
+class InvertedScalarIndex:
+    """Sorted (value, docid) pairs with lazy re-sort; range + term queries.
+
+    The numpy analogue of the reference's RocksDB inverted index
+    (reference: table/inverted_index.h:24): ordered key scan ->
+    searchsorted slice over a value-sorted array.
+    """
+
+    def __init__(self, dtype: np.dtype):
+        self.dtype = dtype
+        self._values = np.zeros(0, dtype=dtype)
+        self._docids = np.zeros(0, dtype=np.int64)
+        self._pending_values: list[Any] = []
+        self._pending_docids: list[int] = []
+        self._sorted = True
+
+    def add(self, value: Any, docid: int) -> None:
+        self._pending_values.append(value)
+        self._pending_docids.append(docid)
+
+    def _ensure_sorted(self) -> None:
+        if self._pending_values:
+            v = np.asarray(self._pending_values, dtype=self.dtype)
+            d = np.asarray(self._pending_docids, dtype=np.int64)
+            self._values = np.concatenate([self._values, v])
+            self._docids = np.concatenate([self._docids, d])
+            self._pending_values.clear()
+            self._pending_docids.clear()
+            self._sorted = False
+        if not self._sorted:
+            order = np.argsort(self._values, kind="stable")
+            self._values = self._values[order]
+            self._docids = self._docids[order]
+            self._sorted = True
+
+    def query(self, cond: Condition, n: int) -> np.ndarray:
+        self._ensure_sorted()
+        op, v = cond.operator, cond.value
+        vals, docs = self._values, self._docids
+        if op in ("IN", "NOT IN"):
+            wanted = v if isinstance(v, (list, tuple)) else [v]
+            hits: list[np.ndarray] = []
+            for w in wanted:
+                lo = np.searchsorted(vals, w, side="left")
+                hi = np.searchsorted(vals, w, side="right")
+                hits.append(docs[lo:hi])
+            ids = np.concatenate(hits) if hits else np.zeros(0, np.int64)
+            mask = np.zeros(n, dtype=bool)
+            mask[ids[ids < n]] = True
+            return ~mask if op == "NOT IN" else mask
+        if op == "<":
+            sel = docs[: np.searchsorted(vals, v, side="left")]
+        elif op == "<=":
+            sel = docs[: np.searchsorted(vals, v, side="right")]
+        elif op == ">":
+            sel = docs[np.searchsorted(vals, v, side="right"):]
+        elif op == ">=":
+            sel = docs[np.searchsorted(vals, v, side="left"):]
+        elif op == "=":
+            lo = np.searchsorted(vals, v, side="left")
+            hi = np.searchsorted(vals, v, side="right")
+            sel = docs[lo:hi]
+        else:  # != / <>
+            lo = np.searchsorted(vals, v, side="left")
+            hi = np.searchsorted(vals, v, side="right")
+            sel = np.concatenate([docs[:lo], docs[hi:]])
+        mask = np.zeros(n, dtype=bool)
+        mask[sel[sel < n]] = True
+        return mask
+
+
+class BitmapScalarIndex:
+    """Per-distinct-value packed bitmap — for low-cardinality fields
+    (reference: table/bitmap_index.h:23 roaring bitmaps)."""
+
+    def __init__(self):
+        self._bitmaps: dict[Any, np.ndarray] = {}
+        self._size = 0
+
+    def add(self, value: Any, docid: int) -> None:
+        values = value if isinstance(value, (list, tuple)) else [value]
+        need = docid + 1
+        for v in values:
+            bm = self._bitmaps.get(v)
+            if bm is None or bm.shape[0] < need:
+                grown = np.zeros(max(need, 1024, 2 * (bm.shape[0] if bm is not None else 0)), dtype=bool)
+                if bm is not None:
+                    grown[: bm.shape[0]] = bm
+                self._bitmaps[v] = grown
+                bm = grown
+            bm[docid] = True
+        self._size = max(self._size, need)
+
+    def query(self, cond: Condition, n: int) -> np.ndarray:
+        op, v = cond.operator, cond.value
+        if op in ("<", "<=", ">", ">="):
+            # range over the distinct values we know
+            keys = [k for k in self._bitmaps if _eval_fixed(np.asarray([k]), cond)[0]]
+        elif op in ("=", "IN"):
+            keys = v if isinstance(v, (list, tuple)) else [v]
+        elif op in ("!=", "<>", "NOT IN"):
+            excl = set(v) if isinstance(v, (list, tuple)) else {v}
+            keys = [k for k in self._bitmaps if k not in excl]
+        else:
+            raise ValueError(f"unsupported operator {op} on bitmap index")
+        mask = np.zeros(n, dtype=bool)
+        for k in keys:
+            bm = self._bitmaps.get(k)
+            if bm is not None:
+                ln = min(n, bm.shape[0])
+                mask[:ln] |= bm[:ln]
+        return mask

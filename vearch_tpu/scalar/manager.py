@@ -1,0 +1,67 @@
+"""Scalar index manager: routes filter conditions to per-field indexes.
+
+TPU-native re-design of the reference's ScalarIndexManager (reference:
+table/scalar_index_manager.h:27-43 — plans filter execution across
+inverted/bitmap/composite indexes). Here the plan is simpler because
+every index yields a docid *mask* and combination is vectorised AND/OR;
+fields without an index fall back to a columnar numpy scan in
+scalar/filter.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from vearch_tpu.engine.types import DataType, ScalarIndexType, TableSchema
+from vearch_tpu.scalar.filter import Condition
+from vearch_tpu.scalar.indexes import BitmapScalarIndex, InvertedScalarIndex
+
+_NUMERIC = {
+    DataType.INT: np.int64,
+    DataType.LONG: np.int64,
+    DataType.FLOAT: np.float64,
+    DataType.DOUBLE: np.float64,
+    DataType.DATE: np.int64,
+}
+
+
+class ScalarIndexManager:
+    def __init__(self, schema: TableSchema):
+        self.schema = schema
+        self._indexes: dict[str, Any] = {}
+        for f in schema.scalar_fields():
+            if f.scalar_index is ScalarIndexType.INVERTED:
+                dtype = _NUMERIC.get(f.data_type)
+                self._indexes[f.name] = InvertedScalarIndex(
+                    np.dtype(dtype) if dtype else np.dtype(object)
+                )
+            elif f.scalar_index is ScalarIndexType.BITMAP:
+                self._indexes[f.name] = BitmapScalarIndex()
+
+    def has_index(self, field: str) -> bool:
+        return field in self._indexes
+
+    def add_docs(self, docs: list[dict[str, Any]], base_docid: int) -> None:
+        for name, index in self._indexes.items():
+            for i, doc in enumerate(docs):
+                if name in doc:
+                    index.add(doc[name], base_docid + i)
+
+    def query(self, cond: Condition, n: int) -> np.ndarray:
+        return self._indexes[cond.field].query(cond, n)
+
+    def rebuild_from_table(self, table) -> None:
+        """Re-derive indexes from the table after Engine.load (indexes are
+        rebuildable state; the table is durable — reference: index
+        rebuildable, raw data durable)."""
+        for name, index in self._indexes.items():
+            try:
+                col = table.column(name)
+                rows = list(col)
+            except KeyError:
+                rows = table.string_column(name)
+            for docid, value in enumerate(rows):
+                if value is not None:
+                    index.add(value, docid)
