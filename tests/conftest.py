@@ -7,15 +7,23 @@ os.environ mutation in conftest (imported before any test module).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-override: the environment presets JAX_PLATFORMS=axon (real TPU);
+# tests must run on the virtual CPU mesh for speed and sharding coverage
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# the jaxtyping pytest plugin imports jax before this conftest runs, so the
+# env var alone is too late — update the live config (backend not yet
+# initialised during collection, so this still takes effect)
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture

@@ -1,0 +1,89 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh
+(conftest.py forces xla_force_host_platform_device_count=8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.types import MetricType
+from vearch_tpu.ops.distance import brute_force_search
+from vearch_tpu.parallel import mesh as mesh_lib
+from vearch_tpu.parallel.sharded import (
+    ShardedFlatSearcher,
+    sharded_int8_search,
+    train_kmeans_sharded,
+)
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_flat_matches_single_device(rng):
+    base = rng.standard_normal((1000, 32)).astype(np.float32)
+    queries = rng.standard_normal((16, 32)).astype(np.float32)
+    mesh = mesh_lib.make_mesh(8)
+    searcher = ShardedFlatSearcher(mesh, base, store_dtype="float32")
+    s_sh, i_sh = searcher.search(queries, 10)
+
+    s_1, i_1 = brute_force_search(
+        jnp.asarray(queries), jnp.asarray(base), None, 10, MetricType.L2
+    )
+    np.testing.assert_array_equal(i_sh, np.asarray(i_1))
+    np.testing.assert_allclose(s_sh, np.asarray(s_1), rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_flat_2d_mesh_query_axis(rng):
+    base = rng.standard_normal((512, 16)).astype(np.float32)
+    queries = rng.standard_normal((8, 16)).astype(np.float32)
+    mesh = mesh_lib.make_mesh(8, data_axis=4, query_axis=2)
+    searcher = ShardedFlatSearcher(mesh, base, store_dtype="float32")
+    s_sh, i_sh = searcher.search(queries, 5)
+    s_1, i_1 = brute_force_search(
+        jnp.asarray(queries), jnp.asarray(base), None, 5, MetricType.L2
+    )
+    np.testing.assert_array_equal(i_sh, np.asarray(i_1))
+
+
+def test_sharded_flat_n_not_divisible(rng):
+    # 1003 rows over 8 shards: padding rows must never surface
+    base = rng.standard_normal((1003, 16)).astype(np.float32)
+    queries = base[:4]
+    mesh = mesh_lib.make_mesh(8)
+    searcher = ShardedFlatSearcher(mesh, base, store_dtype="float32")
+    s_sh, i_sh = searcher.search(queries, 3)
+    assert (i_sh[:, 0] == np.arange(4)).all()
+    assert (i_sh < 1003).all()
+
+
+def test_sharded_kmeans_matches_quality(rng):
+    centers = rng.standard_normal((8, 16)).astype(np.float32) * 4
+    x = np.concatenate(
+        [c + 0.1 * rng.standard_normal((80, 16)).astype(np.float32)
+         for c in centers]
+    )
+    mesh = mesh_lib.make_mesh(8)
+    cents = np.asarray(train_kmeans_sharded(mesh, x, k=8, iters=12))
+    d = np.linalg.norm(centers[:, None] - cents[None], axis=-1)
+    assert (d.min(axis=1) < 0.5).all()
+
+
+def test_sharded_int8_search(rng):
+    base = rng.standard_normal((800, 32)).astype(np.float32)
+    queries = base[:6]
+    mesh = mesh_lib.make_mesh(8)
+    scale = np.maximum(np.abs(base).max(axis=1) / 127.0, 1e-12).astype(np.float32)
+    q8 = np.clip(np.rint(base / scale[:, None]), -127, 127).astype(np.int8)
+    deq = q8.astype(np.float32) * scale[:, None]
+    vsq = np.sum(deq * deq, axis=1).astype(np.float32)
+
+    a8, n = mesh_lib.shard_rows(mesh, q8)
+    sc, _ = mesh_lib.shard_rows(mesh, scale)
+    vs, _ = mesh_lib.shard_rows(mesh, vsq)
+    valid, _ = mesh_lib.shard_rows(mesh, np.arange(a8.shape[0]) < n)
+    qd, b = mesh_lib.shard_queries(mesh, queries)
+    s, i = sharded_int8_search(mesh, a8, sc, vs, valid, qd, 5)
+    i = np.asarray(i)[:b]
+    # int8 quantization is fine enough for self-match top-1
+    assert (i[:, 0] == np.arange(6)).all()

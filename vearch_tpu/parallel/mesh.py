@@ -1,0 +1,81 @@
+"""Device mesh construction for multi-chip partitions.
+
+The reference scales across machines with partition sharding + raft
+replication (reference: SURVEY.md §2.3 — murmur3 slot sharding,
+client-side scatter/gather). Within one partition server, this module adds
+the axis the reference never had: a JAX device mesh over local TPU chips,
+with the vector matrix row-sharded ("data" axis) and the query batch
+sharded ("query" axis). Collectives ride ICI:
+
+- search: per-shard top-k, then all_gather over "data" + re-top-k — the
+  cross-chip merge never leaves the device (SURVEY.md §2.4: TPU-native
+  equivalent of the router's host-side merge, pushed down to ICI);
+- k-means training: psum of per-shard partial sums ("data" axis) — the
+  classic data-parallel reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    data_axis: int | None = None,
+    query_axis: int = 1,
+) -> Mesh:
+    """2D mesh ("data", "query") over the first n devices.
+
+    Default puts all devices on "data" (row sharding) — the right shape
+    for search serving where the DB dwarfs the query batch.
+    """
+    devices = jax.devices()[: (n_devices or len(jax.devices()))]
+    n = len(devices)
+    if data_axis is None:
+        data_axis = n // query_axis
+    assert data_axis * query_axis == n, (
+        f"mesh {data_axis}x{query_axis} != {n} devices"
+    )
+    dev_array = np.asarray(devices).reshape(data_axis, query_axis)
+    return Mesh(dev_array, axis_names=("data", "query"))
+
+
+def shard_rows(mesh: Mesh, x, pad_value=0):
+    """Place a host [N, ...] array row-sharded over the "data" axis,
+    padding N up to a multiple of the axis size. Returns (device_array,
+    orig_n)."""
+    import jax.numpy as jnp
+
+    n_shards = mesh.shape["data"]
+    n = x.shape[0]
+    rem = (-n) % n_shards
+    if rem:
+        pad = np.full((rem,) + x.shape[1:], pad_value, dtype=x.dtype)
+        x = np.concatenate([np.asarray(x), pad], axis=0)
+    sharding = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+    return jax.device_put(jnp.asarray(x), sharding), n
+
+
+def shard_queries(mesh: Mesh, q):
+    """Place a host [B, d] query batch sharded over the "query" axis
+    (replicated over "data")."""
+    import jax.numpy as jnp
+
+    n_shards = mesh.shape["query"]
+    b = q.shape[0]
+    rem = (-b) % n_shards
+    if rem:
+        q = np.concatenate(
+            [np.asarray(q), np.zeros((rem, q.shape[1]), dtype=q.dtype)], axis=0
+        )
+    sharding = NamedSharding(mesh, P("query", None))
+    return jax.device_put(jnp.asarray(q), sharding), b
+
+
+def replicate(mesh: Mesh, x):
+    import jax.numpy as jnp
+
+    spec = P(*([None] * np.ndim(x)))
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))

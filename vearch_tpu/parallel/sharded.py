@@ -1,0 +1,192 @@
+"""shard_map'd multi-chip kernels: sharded search + sharded k-means.
+
+The SPMD layer of the engine. All cross-chip traffic is XLA collectives
+over ICI (all_gather / psum) — no host round-trips inside a step
+(SURVEY.md §2.4: the TPU-native communication backend; the reference's
+NCCL-free design maps to pure data-parallel shard scan + on-device merge).
+
+Layouts (built by parallel/mesh.py):
+    base    [N_pad, d]  rows sharded over "data"
+    queries [B_pad, d]  sharded over "query", replicated over "data"
+    outputs [B_pad, k]  sharded over "query" (global docids)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from vearch_tpu.engine.types import MetricType
+from vearch_tpu.ops import kmeans as km
+from vearch_tpu.ops.distance import brute_force_search
+from vearch_tpu.parallel import mesh as mesh_lib
+
+
+def sharded_flat_search(
+    mesh: Mesh,
+    base: jax.Array,      # [N_pad, d] sharded P("data", None)
+    base_sqnorm: jax.Array,  # [N_pad] sharded P("data")
+    valid: jax.Array,     # [N_pad] bool sharded P("data")
+    queries: jax.Array,   # [B_pad, d] sharded P("query", None)
+    k: int,
+    metric: MetricType = MetricType.L2,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact search over a row-sharded base: local top-k per shard, then
+    all_gather over "data" + global re-top-k, all on device."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("data", None), P("data"), P("data"), P("query", None)),
+        out_specs=(P("query", None), P("query", None)),
+        check_rep=False,
+    )
+    def run(b, sqn, v, q):
+        local_k = min(k, b.shape[0])
+        scores, ids = brute_force_search(q, b, v, local_k, metric, sqn)
+        shard = jax.lax.axis_index("data")
+        gids = jnp.where(ids >= 0, ids + shard * b.shape[0], -1)
+        all_s = jax.lax.all_gather(scores, "data", axis=1, tiled=True)
+        all_i = jax.lax.all_gather(gids, "data", axis=1, tiled=True)
+        kk = min(k, all_s.shape[1])
+        top_s, pos = jax.lax.top_k(all_s, kk)
+        return top_s, jnp.take_along_axis(all_i, pos, axis=1)
+
+    return run(base, base_sqnorm, valid, queries)
+
+
+def sharded_int8_search(
+    mesh: Mesh,
+    approx8: jax.Array,    # [N_pad, d] int8 sharded P("data", None)
+    row_scale: jax.Array,  # [N_pad] sharded P("data")
+    row_vsq: jax.Array,    # [N_pad] sharded P("data")
+    valid: jax.Array,      # [N_pad] bool sharded P("data")
+    queries: jax.Array,    # [B_pad, d] f32 sharded P("query", None)
+    r: int,
+    metric: MetricType = MetricType.L2,
+) -> tuple[jax.Array, jax.Array]:
+    """Sharded compressed scan (the IVFPQ full-scan path across chips)."""
+    from vearch_tpu.ops.ivf import int8_scan_candidates
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("data", None), P("data"), P("data"), P("data"),
+            P("query", None),
+        ),
+        out_specs=(P("query", None), P("query", None)),
+        check_rep=False,
+    )
+    def run(a8, sc, vsq, v, q):
+        local_r = min(r, a8.shape[0])
+        scores, ids = int8_scan_candidates(q, a8, sc, vsq, v, local_r, metric)
+        shard = jax.lax.axis_index("data")
+        gids = ids + shard * a8.shape[0]
+        all_s = jax.lax.all_gather(scores, "data", axis=1, tiled=True)
+        all_i = jax.lax.all_gather(gids, "data", axis=1, tiled=True)
+        rr = min(r, all_s.shape[1])
+        top_s, pos = jax.lax.top_k(all_s, rr)
+        return top_s, jnp.take_along_axis(all_i, pos, axis=1)
+
+    return run(approx8, row_scale, row_vsq, valid, queries)
+
+
+def sharded_kmeans_step(
+    mesh: Mesh,
+    x: jax.Array,        # [N_pad, d] sharded P("data", None)
+    valid: jax.Array,    # [N_pad] bool sharded P("data")
+    centroids: jax.Array,  # [k, d] replicated
+    reseed: jax.Array,   # [k, d] replicated
+    chunk: int = 16384,
+) -> jax.Array:
+    """One Lloyd round over sharded data: per-shard partial stats, psum
+    over "data", identical centroid update everywhere (the distributed
+    training step of the coarse quantizer / PQ codebooks)."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("data", None), P("data"), P(None, None), P(None, None)),
+        out_specs=P(None, None),
+        check_rep=False,
+    )
+    def step(xs, vs, c, rs):
+        local_chunk = min(chunk, max(256, xs.shape[0]))
+        rem = (-xs.shape[0]) % local_chunk
+        if rem:
+            xs = jnp.pad(xs, ((0, rem), (0, 0)))
+            vs = jnp.pad(vs, (0, rem))
+        sums, counts = km.kmeans_partials(xs, vs, c, chunk=local_chunk)
+        # inputs are replicated over "query", so reducing over "data" alone
+        # leaves every device with identical full stats
+        sums = jax.lax.psum(sums, "data")
+        counts = jax.lax.psum(counts, "data")
+        return km.centroids_from_partials(sums, counts, rs)
+
+    return step(x, valid, centroids, reseed)
+
+
+def train_kmeans_sharded(
+    mesh: Mesh, x_host: np.ndarray, k: int, iters: int = 10, seed: int = 0
+) -> jax.Array:
+    """Full multi-chip k-means: k-means++ init on a host sample, then
+    `iters` sharded Lloyd rounds."""
+    n = x_host.shape[0]
+    x_host = np.asarray(x_host, dtype=np.float32)
+    sample = x_host[
+        np.random.default_rng(seed).choice(n, min(n, 65_536), replace=False)
+    ]
+    init = km.kmeanspp_init(jax.random.PRNGKey(seed), jnp.asarray(sample), k)
+    reseed_rows = x_host[
+        np.random.default_rng(seed + 1).choice(n, k, replace=n < k)
+    ]
+
+    x_dev, n_orig = mesh_lib.shard_rows(mesh, x_host)
+    valid_host = np.arange(x_dev.shape[0]) < n_orig
+    valid_dev, _ = mesh_lib.shard_rows(mesh, valid_host)
+    cents = mesh_lib.replicate(mesh, init)
+    reseed = mesh_lib.replicate(mesh, reseed_rows)
+    for _ in range(iters):
+        cents = sharded_kmeans_step(mesh, x_dev, valid_dev, cents, reseed)
+    return cents
+
+
+class ShardedFlatSearcher:
+    """Holds a row-sharded database on a mesh and serves exact search —
+    the multi-chip deployment of a FLAT partition (one partition spanning
+    a TPU slice; the cluster layer still shards *across* partitions)."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        base: np.ndarray,
+        metric: MetricType = MetricType.L2,
+        store_dtype: str = "bfloat16",
+    ):
+        from vearch_tpu.ops.distance import sqnorms
+
+        self.mesh = mesh
+        self.metric = metric
+        self.n = base.shape[0]
+        self.store_dtype = jnp.dtype(store_dtype)
+        base = np.asarray(base, dtype=np.float32)
+        self.base, _ = mesh_lib.shard_rows(mesh, base.astype(self.store_dtype))
+        self.sqnorm = sqnorms(self.base)
+        valid = np.arange(self.base.shape[0]) < self.n
+        self.valid, _ = mesh_lib.shard_rows(mesh, valid)
+
+    def search(self, queries: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+        q, b = mesh_lib.shard_queries(
+            self.mesh, np.asarray(queries, np.float32).astype(self.store_dtype)
+        )
+        scores, ids = sharded_flat_search(
+            self.mesh, self.base, self.sqnorm, self.valid, q, k, self.metric
+        )
+        scores, ids = jax.device_get((scores, ids))
+        return scores[:b], ids[:b]
