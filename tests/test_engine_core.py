@@ -85,7 +85,12 @@ def test_get_returns_fields_and_vector(engine_with_docs):
     docs = eng.get(["doc3"])
     assert docs[0]["_id"] == "doc3"
     assert docs[0]["price"] == 3.0
+    assert "emb" not in docs[0]  # vectors ride only on request
+    docs = eng.get(["doc3"], vector_value=True)
     np.testing.assert_allclose(docs[0]["emb"], vecs[3], rtol=1e-6)
+    # consistent shape via the filter-query path too
+    q = eng.query(filters=None, limit=100, vector_value=True)
+    assert any(d["_id"] == "doc3" and "emb" in d for d in q)
 
 
 def test_batch_search_multiple_queries(engine_with_docs):

@@ -1,0 +1,95 @@
+"""Cluster metadata entities + metastore key schema.
+
+Mirrors the reference's entity layer (reference: internal/entity/space.go:75
+`Space`, partition.go:50 `Partition`, server.go `Server`, meta.go etcd key
+schema). Spaces embed the engine TableSchema plus partition topology.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from vearch_tpu.engine.types import TableSchema
+
+# -- metastore key schema (reference: entity/meta.go) ------------------------
+
+PREFIX_DB = "/db/"
+PREFIX_SPACE = "/space/"  # /space/{db}/{space}
+PREFIX_SERVER = "/server/"  # /server/{node_id}
+PREFIX_PARTITION = "/partition/"  # /partition/{id}
+SEQ_SPACE_ID = "/seq/space"
+SEQ_PARTITION_ID = "/seq/partition"
+SEQ_NODE_ID = "/seq/node"
+
+
+@dataclass
+class Partition:
+    id: int
+    space_id: int
+    db_name: str
+    space_name: str
+    slot: int  # slot range start (reference: entity/partition.go Slot)
+    replicas: list[int] = field(default_factory=list)  # node ids
+    leader: int = -1  # node id of raft leader
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Partition":
+        return cls(**d)
+
+
+@dataclass
+class Space:
+    id: int
+    name: str
+    db_name: str
+    schema: TableSchema
+    partition_num: int = 1
+    replica_num: int = 1
+    partitions: list[Partition] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "db_name": self.db_name,
+            "schema": self.schema.to_dict(),
+            "partition_num": self.partition_num,
+            "replica_num": self.replica_num,
+            "partitions": [p.to_dict() for p in self.partitions],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Space":
+        return cls(
+            id=d["id"],
+            name=d["name"],
+            db_name=d["db_name"],
+            schema=TableSchema.from_dict(d["schema"]),
+            partition_num=d.get("partition_num", 1),
+            replica_num=d.get("replica_num", 1),
+            partitions=[Partition.from_dict(p) for p in d.get("partitions", [])],
+        )
+
+    def slot_starts(self) -> list[int]:
+        return [p.slot for p in self.partitions]
+
+
+@dataclass
+class Server:
+    node_id: int
+    rpc_addr: str  # host:port of the PS data service
+    partition_ids: list[int] = field(default_factory=list)
+    last_heartbeat: float = field(default_factory=time.time)
+    alive: bool = True
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Server":
+        return cls(**d)

@@ -1,0 +1,247 @@
+"""Master (control plane): metadata CRUD, placement, failure detection.
+
+TPU-native re-design of the reference's master role (reference:
+internal/master/cluster_api.go:244 admin routes;
+services/space_service.go:59 CreateSpace — schema validate, cluster lock,
+slot carving, placement; master_cache.go lease-expiry failure detection).
+Route names mirror the reference so SDKs port over directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.entities import (
+    PREFIX_DB,
+    PREFIX_SERVER,
+    PREFIX_SPACE,
+    SEQ_NODE_ID,
+    SEQ_PARTITION_ID,
+    SEQ_SPACE_ID,
+    Partition,
+    Server,
+    Space,
+)
+from vearch_tpu.cluster.hashing import carve_slots
+from vearch_tpu.cluster.metastore import MetaStore
+from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+from vearch_tpu.engine.types import TableSchema
+
+HEARTBEAT_TTL = 8.0
+
+
+class MasterServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_path: str | None = None,
+    ):
+        self.store = MetaStore(persist_path)
+        self._stop = threading.Event()
+        self._leases: dict[int, int] = {}  # node_id -> lease id
+
+        self.server = JsonRpcServer(host, port)
+        s = self.server
+        s.route("GET", "/", self._h_cluster_info)
+        s.route("POST", "/register", self._h_register)
+        s.route("GET", "/servers", self._h_servers)
+        s.route("POST", "/dbs", self._h_create_db)  # POST /dbs/{db}
+        s.route("GET", "/dbs", self._h_get_db)
+        s.route("DELETE", "/dbs", self._h_delete_db)
+        s.route("GET", "/partitions", self._h_partitions)
+
+    def start(self) -> None:
+        self.server.start()
+        threading.Thread(target=self._lease_reaper, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    # -- failure detection (reference: master_cache.go:963-1005) -------------
+
+    def _lease_reaper(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(1.0)
+            for key in self.store.expire_leases():
+                if key.startswith(PREFIX_SERVER):
+                    # durable FailServer record; auto-recovery re-places
+                    # replicas in a later round (services/server_service.go:95)
+                    node_id = key[len(PREFIX_SERVER):]
+                    self.store.put(f"/fail_server/{node_id}", {
+                        "node_id": int(node_id), "time": time.time(),
+                    })
+
+    # -- servers -------------------------------------------------------------
+
+    def _h_register(self, body: dict, _parts) -> dict:
+        node_id = body.get("node_id")
+        if node_id is None:
+            node_id = self.store.next_id(SEQ_NODE_ID)
+        node_id = int(node_id)
+        key = f"{PREFIX_SERVER}{node_id}"
+        existing = self.store.get(key)
+        server = Server(
+            node_id=node_id,
+            rpc_addr=body["rpc_addr"],
+            partition_ids=(existing or {}).get("partition_ids", []),
+        )
+        lease = self._leases.get(node_id)
+        if lease is None or not self.store.keepalive(lease, HEARTBEAT_TTL):
+            lease = self.store.grant_lease(HEARTBEAT_TTL)
+            self._leases[node_id] = lease
+        self.store.put(key, server.to_dict(), lease=lease)
+        self.store.delete(f"/fail_server/{node_id}")
+        return {"node_id": node_id}
+
+    def _h_servers(self, _body, _parts) -> dict:
+        return {"servers": list(self.store.prefix(PREFIX_SERVER).values())}
+
+    def _alive_servers(self) -> list[Server]:
+        return [
+            Server.from_dict(d)
+            for d in self.store.prefix(PREFIX_SERVER).values()
+        ]
+
+    # -- dbs / spaces --------------------------------------------------------
+
+    def _h_create_db(self, body: dict, parts) -> dict:
+        if len(parts) == 1:
+            # POST /dbs/{db} — create db
+            db = parts[0]
+            if self.store.get(f"{PREFIX_DB}{db}") is not None:
+                raise RpcError(409, f"db {db} exists")
+            self.store.put(f"{PREFIX_DB}{db}", {"name": db, "create_time": time.time()})
+            return {"name": db}
+        if len(parts) == 2 and parts[1] == "spaces":
+            return self._create_space(parts[0], body)
+        raise RpcError(404, f"bad path {parts}")
+
+    def _h_get_db(self, _body, parts) -> Any:
+        if not parts:
+            return {"dbs": list(self.store.prefix(PREFIX_DB).values())}
+        db = parts[0]
+        if len(parts) == 1:
+            d = self.store.get(f"{PREFIX_DB}{db}")
+            if d is None:
+                raise RpcError(404, f"db {db} not found")
+            return d
+        if len(parts) == 2 and parts[1] == "spaces":
+            return {"spaces": list(self.store.prefix(f"{PREFIX_SPACE}{db}/").values())}
+        if len(parts) == 3 and parts[1] == "spaces":
+            sp = self.store.get(f"{PREFIX_SPACE}{db}/{parts[2]}")
+            if sp is None:
+                raise RpcError(404, f"space {db}/{parts[2]} not found")
+            return sp
+        raise RpcError(404, f"bad path {parts}")
+
+    def _h_delete_db(self, _body, parts) -> dict:
+        if len(parts) == 1:
+            db = parts[0]
+            if self.store.prefix(f"{PREFIX_SPACE}{db}/"):
+                raise RpcError(409, f"db {db} still has spaces")
+            self.store.delete(f"{PREFIX_DB}{db}")
+            return {"name": db}
+        if len(parts) == 3 and parts[1] == "spaces":
+            return self._delete_space(parts[0], parts[2])
+        raise RpcError(404, f"bad path {parts}")
+
+    def _h_partitions(self, _body, _parts) -> dict:
+        out = []
+        for sp in self.store.prefix(PREFIX_SPACE).values():
+            out.extend(sp["partitions"])
+        return {"partitions": out}
+
+    def _h_cluster_info(self, _body, _parts) -> dict:
+        return {
+            "name": "vearch-tpu",
+            "version": "0.1.0",
+            "status": "green" if self._alive_servers() else "yellow",
+        }
+
+    # -- space create (reference: services/space_service.go:59) --------------
+
+    def _create_space(self, db: str, body: dict) -> dict:
+        if self.store.get(f"{PREFIX_DB}{db}") is None:
+            raise RpcError(404, f"db {db} not found")
+        name = body["name"]
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        if self.store.get(key) is not None:
+            raise RpcError(409, f"space {db}/{name} exists")
+        if not self.store.try_lock("space_create", f"{db}/{name}"):
+            raise RpcError(409, "space create in progress")
+        try:
+            schema = TableSchema.from_dict(
+                {"name": name, **{k: body[k] for k in ("fields",) if k in body},
+                 "training_threshold": body.get("training_threshold", 0),
+                 "refresh_interval_ms": body.get("refresh_interval_ms", 1000)}
+            )
+            partition_num = int(body.get("partition_num", 1))
+            replica_num = int(body.get("replica_num", 1))
+            servers = self._alive_servers()
+            if not servers:
+                raise RpcError(503, "no partition servers registered")
+            if replica_num > len(servers):
+                raise RpcError(
+                    400,
+                    f"replica_num {replica_num} > {len(servers)} servers",
+                )
+            space_id = self.store.next_id(SEQ_SPACE_ID)
+            slots = carve_slots(partition_num)
+            space = Space(
+                id=space_id, name=name, db_name=db, schema=schema,
+                partition_num=partition_num, replica_num=replica_num,
+            )
+            # round-robin placement with replica anti-affinity by node
+            # (reference: space_service.go:141-149 + replica placement)
+            for i in range(partition_num):
+                pid = self.store.next_id(SEQ_PARTITION_ID)
+                replicas = [
+                    servers[(i + r) % len(servers)].node_id
+                    for r in range(replica_num)
+                ]
+                part = Partition(
+                    id=pid, space_id=space_id, db_name=db, space_name=name,
+                    slot=slots[i], replicas=replicas, leader=replicas[0],
+                )
+                for node_id in replicas:
+                    srv = next(s for s in servers if s.node_id == node_id)
+                    rpc.call(srv.rpc_addr, "POST", "/ps/partition/create", {
+                        "partition": part.to_dict(),
+                        "schema": schema.to_dict(),
+                    })
+                    srv.partition_ids.append(pid)
+                    self.store.put(f"{PREFIX_SERVER}{node_id}", srv.to_dict())
+                space.partitions.append(part)
+            self.store.put(key, space.to_dict())
+            return space.to_dict()
+        finally:
+            self.store.unlock("space_create", f"{db}/{name}")
+
+    def _delete_space(self, db: str, name: str) -> dict:
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        sp = self.store.get(key)
+        if sp is None:
+            raise RpcError(404, f"space {db}/{name} not found")
+        space = Space.from_dict(sp)
+        servers = {s.node_id: s for s in self._alive_servers()}
+        for part in space.partitions:
+            for node_id in part.replicas:
+                srv = servers.get(node_id)
+                if srv is None:
+                    continue
+                try:
+                    rpc.call(srv.rpc_addr, "POST", "/ps/partition/delete",
+                             {"partition_id": part.id})
+                except RpcError:
+                    pass
+        self.store.delete(key)
+        return {"name": name}

@@ -1,0 +1,142 @@
+"""Cluster metadata store: KV + watches + leases + sequences.
+
+TPU-native stand-in for the reference's embedded etcd (reference:
+internal/master/server.go:89 embedded etcd; client/master_cache.go watch
+-driven caches; master/store/distlock.go). Same primitives the reference
+leans on — prefix watch, lease-with-TTL liveness, atomic sequences,
+mutex — implemented in-process for the master role. Multi-master
+replication of the metastore itself is a later-round concern (the
+reference delegates it to etcd raft); the interface is shaped so a raft
+log can slide underneath without touching callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable
+
+
+class MetaStore:
+    def __init__(self, persist_path: str | None = None):
+        self._kv: dict[str, Any] = {}
+        self._lock = threading.RLock()
+        self._watches: list[tuple[str, Callable[[str, str, Any], None]]] = []
+        self._leases: dict[int, tuple[float, list[str]]] = {}  # id -> (expiry, keys)
+        self._next_lease = 1
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path) as f:
+                self._kv = json.load(f)
+
+    # -- KV ------------------------------------------------------------------
+
+    def put(self, key: str, value: Any, lease: int | None = None) -> None:
+        with self._lock:
+            self._kv[key] = value
+            if lease is not None and lease in self._leases:
+                self._leases[lease][1].append(key)
+            self._persist()
+            watchers = [(p, cb) for p, cb in self._watches if key.startswith(p)]
+        for _, cb in watchers:
+            cb("PUT", key, value)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._kv.get(key, default)
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            existed = key in self._kv
+            self._kv.pop(key, None)
+            self._persist()
+            watchers = [(p, cb) for p, cb in self._watches if key.startswith(p)]
+        if existed:
+            for _, cb in watchers:
+                cb("DELETE", key, None)
+        return existed
+
+    def prefix(self, prefix: str) -> dict[str, Any]:
+        with self._lock:
+            return {k: v for k, v in self._kv.items() if k.startswith(prefix)}
+
+    def cas(self, key: str, expect: Any, value: Any) -> bool:
+        """Compare-and-swap (reference: etcd STM transactions)."""
+        with self._lock:
+            if self._kv.get(key) != expect:
+                return False
+            self._kv[key] = value
+            self._persist()
+        return True
+
+    # -- watches (reference: client/master_cache.go:414) ---------------------
+
+    def watch_prefix(self, prefix: str, cb: Callable[[str, str, Any], None]) -> None:
+        with self._lock:
+            self._watches.append((prefix, cb))
+
+    # -- sequences (reference: etcd sequence for space/partition/node ids) ---
+
+    def next_id(self, seq_key: str) -> int:
+        with self._lock:
+            nxt = int(self._kv.get(seq_key, 0)) + 1
+            self._kv[seq_key] = nxt
+            self._persist()
+            return nxt
+
+    # -- leases (reference: PS registration lease, server.go:228) ------------
+
+    def grant_lease(self, ttl_s: float) -> int:
+        with self._lock:
+            lease = self._next_lease
+            self._next_lease += 1
+            self._leases[lease] = (time.time() + ttl_s, [])
+            return lease
+
+    def keepalive(self, lease: int, ttl_s: float) -> bool:
+        with self._lock:
+            if lease not in self._leases:
+                return False
+            self._leases[lease] = (time.time() + ttl_s, self._leases[lease][1])
+            return True
+
+    def expire_leases(self) -> list[str]:
+        """Drop expired leases; returns the keys deleted (the master's
+        failure-detection tick — reference: lease expiry fires the
+        server-watch DELETE, master_cache.go:963)."""
+        now = time.time()
+        with self._lock:
+            dead = [lid for lid, (exp, _) in self._leases.items() if exp < now]
+            doomed: list[str] = []
+            for lid in dead:
+                doomed.extend(self._leases.pop(lid)[1])
+        for key in doomed:
+            self.delete(key)
+        return doomed
+
+    # -- distributed lock (reference: master/store/distlock.go) --------------
+
+    def try_lock(self, name: str, owner: str, ttl_s: float = 30.0) -> bool:
+        key = f"/lock/{name}"
+        with self._lock:
+            cur = self._kv.get(key)
+            if cur is not None and cur["expiry"] > time.time() and cur["owner"] != owner:
+                return False
+            self._kv[key] = {"owner": owner, "expiry": time.time() + ttl_s}
+            return True
+
+    def unlock(self, name: str, owner: str) -> None:
+        key = f"/lock/{name}"
+        with self._lock:
+            cur = self._kv.get(key)
+            if cur is not None and cur["owner"] == owner:
+                self._kv.pop(key, None)
+
+    def _persist(self) -> None:
+        if self._persist_path:
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._kv, f)
+            os.replace(tmp, self._persist_path)

@@ -1,0 +1,234 @@
+"""Partition server (data plane): hosts one Engine per partition.
+
+TPU-native re-design of the reference's PS role (reference:
+internal/ps/server.go:76 lifecycle + partition registry sync.Map;
+handler_document.go:64 data RPC; handler_admin.go:90 admin RPC;
+partition_service.go:154 create/recover). Raft replication slots in at
+this layer in a later round (replica_num=1 paths are complete); the
+handler surface already mirrors the reference's admin/data split.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import TableSchema
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.entities import Partition
+from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+
+
+class PSServer:
+    def __init__(
+        self,
+        data_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        master_addr: str | None = None,
+        heartbeat_interval: float = 2.0,
+    ):
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.engines: dict[int, Engine] = {}
+        self.partitions: dict[int, Partition] = {}
+        self._lock = threading.Lock()
+        self.master_addr = master_addr
+        self.node_id: int | None = None
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+
+        self.server = JsonRpcServer(host, port)
+        s = self.server
+        s.route("POST", "/ps/partition/create", self._h_create_partition)
+        s.route("POST", "/ps/partition/delete", self._h_delete_partition)
+        s.route("POST", "/ps/doc/upsert", self._h_upsert)
+        s.route("POST", "/ps/doc/delete", self._h_delete)
+        s.route("POST", "/ps/doc/get", self._h_get)
+        s.route("POST", "/ps/doc/search", self._h_search)
+        s.route("POST", "/ps/doc/query", self._h_query)
+        s.route("POST", "/ps/index/build", self._h_build)
+        s.route("POST", "/ps/index/rebuild", self._h_rebuild)
+        s.route("POST", "/ps/flush", self._h_flush)
+        s.route("GET", "/ps/stats", self._h_stats)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        self._recover_partitions()
+        if self.master_addr:
+            self._register()
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def _register(self) -> None:
+        """Register with the master, retrying forever (reference:
+        ps/server.go:228 lease-backed registration)."""
+        while not self._stop.is_set():
+            try:
+                data = rpc.call(
+                    self.master_addr, "POST", "/register",
+                    {"rpc_addr": self.addr, "node_id": self.node_id},
+                )
+                self.node_id = data["node_id"]
+                return
+            except RpcError:
+                time.sleep(0.5)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.heartbeat_interval)
+            try:
+                rpc.call(
+                    self.master_addr, "POST", "/register",
+                    {"rpc_addr": self.addr, "node_id": self.node_id},
+                )
+            except RpcError:
+                pass
+
+    def _recover_partitions(self) -> None:
+        """Reload engines dumped under data_dir (reference:
+        partition_service.go:275 recoverPartitions)."""
+        for name in os.listdir(self.data_dir):
+            p = os.path.join(self.data_dir, name)
+            if name.startswith("partition_") and os.path.isdir(p):
+                pid = int(name.split("_")[1])
+                try:
+                    self.engines[pid] = Engine.open(p)
+                except Exception:
+                    continue
+
+    # -- handlers ------------------------------------------------------------
+
+    def _engine(self, pid: int) -> Engine:
+        eng = self.engines.get(int(pid))
+        if eng is None:
+            raise RpcError(404, f"partition {pid} not on this node")
+        return eng
+
+    def _h_create_partition(self, body: dict, _parts) -> dict:
+        pid = int(body["partition"]["id"])
+        with self._lock:
+            if pid in self.engines:
+                raise RpcError(409, f"partition {pid} already exists")
+            schema = TableSchema.from_dict(body["schema"])
+            data_dir = os.path.join(self.data_dir, f"partition_{pid}")
+            self.engines[pid] = Engine(schema, data_dir=data_dir)
+            self.partitions[pid] = Partition.from_dict(body["partition"])
+        return {"partition_id": pid}
+
+    def _h_delete_partition(self, body: dict, _parts) -> dict:
+        pid = int(body["partition_id"])
+        with self._lock:
+            self.engines.pop(pid, None)
+            self.partitions.pop(pid, None)
+        import shutil
+
+        shutil.rmtree(
+            os.path.join(self.data_dir, f"partition_{pid}"), ignore_errors=True
+        )
+        return {"partition_id": pid}
+
+    def _h_upsert(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        keys = eng.upsert(body["documents"])
+        return {"keys": keys, "count": len(keys)}
+
+    def _h_delete(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        if body.get("keys"):
+            return {"deleted": eng.delete(body["keys"])}
+        # delete-by-filter (reference: /document/delete with filters)
+        docs = eng.query(body.get("filters"), limit=body.get("limit", 10_000),
+                         include_fields=[])
+        keys = [d["_id"] for d in docs]
+        return {"deleted": eng.delete(keys), "keys": keys}
+
+    def _h_get(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        return {"documents": eng.get(body["keys"], body.get("fields"),
+                                      bool(body.get("vector_value", False)))}
+
+    def _h_search(self, body: dict, _parts) -> dict:
+        import numpy as np
+
+        eng = self._engine(body["partition_id"])
+        vectors = {
+            name: np.asarray(v, dtype=np.float32)
+            for name, v in body["vectors"].items()
+        }
+        req = SearchRequest(
+            vectors=vectors,
+            k=int(body.get("k", 10)),
+            filters=body.get("filters"),
+            include_fields=body.get("include_fields"),
+            brute_force=bool(body.get("brute_force", False)),
+            field_weights=body.get("field_weights") or {},
+            index_params=body.get("index_params") or {},
+        )
+        results = eng.search(req)
+        metric = eng.indexes[next(iter(vectors))].metric.value
+        return {
+            "metric": metric,
+            "results": [
+                [
+                    {"_id": it.key, "_score": it.score, **it.fields}
+                    for it in r.items
+                ]
+                for r in results
+            ],
+        }
+
+    def _h_query(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        vv = bool(body.get("vector_value", False))
+        if body.get("document_ids"):
+            docs = eng.get(body["document_ids"], body.get("fields"), vv)
+        else:
+            docs = eng.query(
+                body.get("filters"),
+                limit=int(body.get("limit", 50)),
+                offset=int(body.get("offset", 0)),
+                include_fields=body.get("fields"),
+                vector_value=vv,
+            )
+        return {"documents": docs}
+
+    def _h_build(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        eng.build_index()
+        return {"status": int(eng.status)}
+
+    def _h_rebuild(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        eng.rebuild_index()
+        return {"status": int(eng.status)}
+
+    def _h_flush(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        eng.dump()
+        return {"doc_count": eng.doc_count}
+
+    def _h_stats(self, _body, _parts) -> dict:
+        return {
+            "node_id": self.node_id,
+            "partitions": {
+                str(pid): {
+                    "doc_count": eng.doc_count,
+                    "status": int(eng.status),
+                }
+                for pid, eng in self.engines.items()
+            },
+        }

@@ -1,0 +1,344 @@
+"""Router (stateless query tier): doc parse, scatter/gather, merge.
+
+TPU-native re-design of the reference's router role (reference:
+internal/router/document/doc_http.go:306-335 routes /document/{upsert,
+search,query,delete} + /index/{flush,forcemerge,rebuild};
+doc_query.go:165 parseSearch; client/client.go:382 Execute scatter /
+:779 SearchFieldSortExecute gather-merge). Document routing is
+murmur3-slot compatible with the reference; the per-partition fan-out
+runs on a thread pool (one worker per partition RPC, like the
+reference's goroutine-per-partition).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.entities import Server, Space
+from vearch_tpu.cluster.hashing import key_slot, partition_for_slot
+from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+
+SPACE_CACHE_TTL = 3.0
+
+
+class RouterServer:
+    def __init__(
+        self, master_addr: str, host: str = "127.0.0.1", port: int = 0
+    ):
+        self.master_addr = master_addr
+        self._space_cache: dict[str, tuple[float, Space]] = {}
+        self._server_cache: tuple[float, dict[int, Server]] = (0.0, {})
+        self._cache_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=32)
+
+        self.server = JsonRpcServer(host, port)
+        s = self.server
+        s.route("POST", "/document/upsert", self._h_upsert)
+        s.route("POST", "/document/search", self._h_search)
+        s.route("POST", "/document/query", self._h_query)
+        s.route("POST", "/document/delete", self._h_delete)
+        s.route("POST", "/index/flush", self._h_flush)
+        s.route("POST", "/index/forcemerge", self._h_forcemerge)
+        s.route("POST", "/index/rebuild", self._h_rebuild)
+        # master proxy (reference: doc_http.go:189-251 master-proxy routes)
+        for method in ("GET", "POST", "PUT", "DELETE"):
+            s.route(method, "/dbs", self._proxy_master(method, "/dbs"))
+        s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
+        s.route("GET", "/cluster/health", self._h_health)
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self._pool.shutdown(wait=False)
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    # -- metadata caches (reference: client/master_cache.go watch caches;
+    #    TTL polling stands in for watches until the metastore is remote) ---
+
+    def _space(self, db: str, name: str) -> Space:
+        key = f"{db}/{name}"
+        now = time.time()
+        with self._cache_lock:
+            hit = self._space_cache.get(key)
+            if hit and now - hit[0] < SPACE_CACHE_TTL:
+                return hit[1]
+        data = rpc.call(self.master_addr, "GET", f"/dbs/{db}/spaces/{name}")
+        space = Space.from_dict(data)
+        with self._cache_lock:
+            self._space_cache[key] = (now, space)
+        return space
+
+    def _servers(self) -> dict[int, Server]:
+        now = time.time()
+        with self._cache_lock:
+            ts, cache = self._server_cache
+            if now - ts < SPACE_CACHE_TTL and cache:
+                return cache
+        data = rpc.call(self.master_addr, "GET", "/servers")
+        servers = {
+            s["node_id"]: Server.from_dict(s) for s in data["servers"]
+        }
+        with self._cache_lock:
+            self._server_cache = (now, servers)
+        return servers
+
+    def _partition_addr(self, space: Space, partition_id: int) -> str:
+        servers = self._servers()
+        part = next(p for p in space.partitions if p.id == partition_id)
+        node = part.leader if part.leader >= 0 else part.replicas[0]
+        srv = servers.get(node)
+        if srv is None:
+            raise RpcError(503, f"no server for partition {partition_id}")
+        return srv.rpc_addr
+
+    def _proxy_master(self, method: str, prefix: str):
+        def h(body, parts):
+            path = prefix + ("/" + "/".join(parts) if parts else "")
+            return rpc.call(self.master_addr, method, path, body)
+
+        return h
+
+    def _h_health(self, _body, _parts) -> dict:
+        return rpc.call(self.master_addr, "GET", "/")
+
+    # -- document routes -----------------------------------------------------
+
+    def _route_docs(
+        self, space: Space, docs: list[dict]
+    ) -> dict[int, list[dict]]:
+        """murmur3(_id) -> slot -> partition (reference: client.go:239
+        PartitionDocs)."""
+        import uuid
+
+        starts = space.slot_starts()
+        by_partition: dict[int, list[dict]] = {}
+        for doc in docs:
+            if "_id" not in doc:
+                doc = {**doc, "_id": uuid.uuid4().hex}
+            idx = partition_for_slot(starts, key_slot(str(doc["_id"])))
+            pid = space.partitions[idx].id
+            by_partition.setdefault(pid, []).append(doc)
+        return by_partition
+
+    def _h_upsert(self, body: dict, _parts) -> dict:
+        space = self._space(body["db_name"], body["space_name"])
+        self._validate_docs(space, body["documents"])
+        by_partition = self._route_docs(space, body["documents"])
+
+        def send(pid: int, docs: list[dict]):
+            return rpc.call(
+                self._partition_addr(space, pid), "POST", "/ps/doc/upsert",
+                {"partition_id": pid, "documents": docs},
+            )
+
+        futures = [
+            self._pool.submit(send, pid, docs)
+            for pid, docs in by_partition.items()
+        ]
+        keys: list[str] = []
+        for f in futures:
+            keys.extend(f.result()["keys"])
+        return {"total": len(keys), "document_ids": keys}
+
+    def _validate_docs(self, space: Space, docs: list[dict]) -> None:
+        """Schema validation at the router (reference: doc_parse.go —
+        vector dims, unknown fields)."""
+        vf = {f.name: f for f in space.schema.vector_fields()}
+        known = {f.name for f in space.schema.fields} | {"_id"}
+        for doc in docs:
+            for name, f in vf.items():
+                v = doc.get(name)
+                if v is None:
+                    raise RpcError(400, f"missing vector field {name!r}")
+                if len(v) != f.dimension:
+                    raise RpcError(
+                        400,
+                        f"vector field {name!r} length {len(v)} != "
+                        f"dimension {f.dimension}",
+                    )
+            for k in doc:
+                if k not in known:
+                    raise RpcError(400, f"unknown field {k!r}")
+
+    def _parse_vectors(self, space: Space, body: dict) -> dict[str, list]:
+        """reference: doc_query.go:165 parseSearch — `vectors` is a list of
+        {field, feature} with feature a flattened batch."""
+        out: dict[str, list] = {}
+        nq = None
+        for v in body.get("vectors", []):
+            f = space.schema.field(v["field"])
+            feat = v["feature"]
+            if len(feat) % max(f.dimension, 1) != 0:
+                raise RpcError(
+                    400,
+                    f"feature length {len(feat)} not divisible by "
+                    f"dimension {f.dimension}",
+                )
+            b = len(feat) // f.dimension
+            if nq is None:
+                nq = b
+            elif nq != b:
+                raise RpcError(400, "inconsistent query batch across fields")
+            out[v["field"]] = [
+                feat[i * f.dimension : (i + 1) * f.dimension] for i in range(b)
+            ]
+        if not out:
+            raise RpcError(400, "search requires `vectors`")
+        return out
+
+    def _h_search(self, body: dict, _parts) -> dict:
+        space = self._space(body["db_name"], body["space_name"])
+        vectors = self._parse_vectors(space, body)
+        k = int(body.get("limit", body.get("topn", 10)))
+        sub = {
+            "vectors": vectors,
+            "k": k,
+            "filters": body.get("filters"),
+            "include_fields": body.get("fields"),
+            "index_params": body.get("index_params") or {},
+            "field_weights": {
+                r["field"]: r["weight"]
+                for r in body.get("ranker", {}).get("params", [])
+            } if isinstance(body.get("ranker"), dict) else {},
+        }
+
+        def send(pid: int):
+            return rpc.call(
+                self._partition_addr(space, pid), "POST", "/ps/doc/search",
+                {**sub, "partition_id": pid},
+            )
+
+        futures = [
+            self._pool.submit(send, p.id) for p in space.partitions
+        ]
+        partials = [f.result() for f in futures]
+        merged = self._merge_search(partials, k)
+        return {"documents": merged}
+
+    def _merge_search(
+        self, partials: list[dict], k: int
+    ) -> list[list[dict]]:
+        """Top-k merge across partitions (reference: client.go:779 sorted
+        merge). Scores are metric-oriented: L2 ascending, IP/cosine
+        descending."""
+        if not partials:
+            return []
+        metric = partials[0]["metric"]
+        reverse = metric != "L2"
+        nq = len(partials[0]["results"])
+        out = []
+        for qi in range(nq):
+            rows: list[dict] = []
+            for p in partials:
+                rows.extend(p["results"][qi])
+            rows.sort(key=lambda r: r["_score"], reverse=reverse)
+            out.append(rows[:k])
+        return out
+
+    def _h_query(self, body: dict, _parts) -> dict:
+        space = self._space(body["db_name"], body["space_name"])
+        if body.get("document_ids"):
+            starts = space.slot_starts()
+            by_partition: dict[int, list[str]] = {}
+            for key in body["document_ids"]:
+                idx = partition_for_slot(starts, key_slot(str(key)))
+                pid = space.partitions[idx].id
+                by_partition.setdefault(pid, []).append(str(key))
+
+            def send(pid: int, keys: list[str]):
+                return rpc.call(
+                    self._partition_addr(space, pid), "POST", "/ps/doc/query",
+                    {"partition_id": pid, "document_ids": keys,
+                     "fields": body.get("fields"),
+                     "vector_value": body.get("vector_value", False)},
+                )
+
+            futures = [
+                self._pool.submit(send, pid, keys)
+                for pid, keys in by_partition.items()
+            ]
+            docs: list[dict] = []
+            for f in futures:
+                docs.extend(f.result()["documents"])
+            return {"total": len(docs), "documents": docs}
+
+        limit = int(body.get("limit", 50))
+
+        def send_filter(pid: int):
+            return rpc.call(
+                self._partition_addr(space, pid), "POST", "/ps/doc/query",
+                {"partition_id": pid, "filters": body.get("filters"),
+                 "limit": limit, "offset": int(body.get("offset", 0)),
+                 "fields": body.get("fields"),
+                 "vector_value": body.get("vector_value", False)},
+            )
+
+        futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
+        docs = []
+        for f in futures:
+            docs.extend(f.result()["documents"])
+        return {"total": len(docs), "documents": docs[:limit]}
+
+    def _h_delete(self, body: dict, _parts) -> dict:
+        space = self._space(body["db_name"], body["space_name"])
+        if body.get("document_ids"):
+            starts = space.slot_starts()
+            by_partition: dict[int, list[str]] = {}
+            for key in body["document_ids"]:
+                idx = partition_for_slot(starts, key_slot(str(key)))
+                pid = space.partitions[idx].id
+                by_partition.setdefault(pid, []).append(str(key))
+
+            def send(pid: int, keys: list[str]):
+                return rpc.call(
+                    self._partition_addr(space, pid), "POST", "/ps/doc/delete",
+                    {"partition_id": pid, "keys": keys},
+                )
+
+            futures = [
+                self._pool.submit(send, pid, keys)
+                for pid, keys in by_partition.items()
+            ]
+            return {"total": sum(f.result()["deleted"] for f in futures)}
+
+        def send_filter(pid: int):
+            return rpc.call(
+                self._partition_addr(space, pid), "POST", "/ps/doc/delete",
+                {"partition_id": pid, "filters": body.get("filters"),
+                 "limit": int(body.get("limit", 10_000))},
+            )
+
+        futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
+        return {"total": sum(f.result()["deleted"] for f in futures)}
+
+    # -- index ops (reference: doc_http.go /index/{flush,forcemerge,rebuild})
+
+    def _index_op(self, body: dict, ps_path: str) -> dict:
+        space = self._space(body["db_name"], body["space_name"])
+
+        def send(pid: int):
+            return rpc.call(
+                self._partition_addr(space, pid), "POST", ps_path,
+                {"partition_id": pid},
+            )
+
+        futures = [self._pool.submit(send, p.id) for p in space.partitions]
+        return {"partitions": [f.result() for f in futures]}
+
+    def _h_flush(self, body: dict, _parts) -> dict:
+        return self._index_op(body, "/ps/flush")
+
+    def _h_forcemerge(self, body: dict, _parts) -> dict:
+        return self._index_op(body, "/ps/index/build")
+
+    def _h_rebuild(self, body: dict, _parts) -> dict:
+        return self._index_op(body, "/ps/index/rebuild")

@@ -1,0 +1,148 @@
+"""Minimal JSON-over-HTTP RPC layer (stdlib only).
+
+Plays the role of the reference's rpcx+protobuf transport (reference:
+internal/pkg/server/rpc/rpc_server.go:33 — custom codec, handler chains
+with panic recovery, per-handler timeouts). JSON keeps round 1 dependency
+-free; the wire format is isolated behind `call()` / `JsonRpcServer` so a
+binary codec (C++ extension) can replace it without touching services.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+class JsonRpcServer:
+    """Route table of (method, path-prefix) -> handler(body, path_parts).
+
+    Handlers return a JSON-serialisable object or raise RpcError; panics
+    are caught and surfaced as 500s (reference: handler chains with panic
+    recovery, pkg/server/rpc/handler/).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes: list[tuple[str, str, Callable]] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _serve(self, method: str):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    body = json.loads(raw) if raw else None
+                    handler, parts = outer._match(method, self.path)
+                    if handler is None:
+                        self._reply(404, {"code": 404, "msg": f"no route {method} {self.path}"})
+                        return
+                    result = handler(body, parts)
+                    self._reply(200, {"code": 0, "data": result})
+                except RpcError as e:
+                    self._reply(200, {"code": e.code, "msg": e.msg})
+                except Exception as e:  # panic recovery
+                    self._reply(
+                        500,
+                        {"code": 500, "msg": f"{type(e).__name__}: {e}",
+                         "trace": traceback.format_exc(limit=8)},
+                    )
+
+            def _reply(self, status: int, obj: dict):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._serve("GET")
+
+            def do_POST(self):
+                self._serve("POST")
+
+            def do_PUT(self):
+                self._serve("PUT")
+
+            def do_DELETE(self):
+                self._serve("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread: threading.Thread | None = None
+
+    def route(self, method: str, prefix: str, handler: Callable) -> None:
+        """Register handler(body, parts) where parts = path segments after
+        the prefix."""
+        self._routes.append((method, prefix.rstrip("/"), handler))
+
+    def _match(self, method: str, path: str):
+        path = path.split("?")[0].rstrip("/")
+        best = None
+        best_len = -1
+        for m, prefix, h in self._routes:
+            if m != method:
+                continue
+            if path == prefix or path.startswith(prefix + "/"):
+                if len(prefix) > best_len:
+                    rest = path[len(prefix):].strip("/")
+                    parts = rest.split("/") if rest else []
+                    best = (h, parts)
+                    best_len = len(prefix)
+        return best if best else (None, None)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def call(
+    addr: str,
+    method: str,
+    path: str,
+    body: Any = None,
+    timeout: float = 120.0,
+) -> Any:
+    """Client side: raises RpcError on non-zero code."""
+    url = f"http://{addr}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except Exception:
+            raise RpcError(e.code, str(e)) from e
+    except urllib.error.URLError as e:
+        raise RpcError(-1, f"unreachable {addr}: {e}") from e
+    if payload.get("code", 0) != 0:
+        raise RpcError(payload["code"], payload.get("msg", "rpc error"))
+    return payload.get("data")

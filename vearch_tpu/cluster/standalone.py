@@ -1,0 +1,58 @@
+"""Standalone cluster: master + router + N partition servers in-process.
+
+The reference ships an all-in-one mode where one binary runs every role
+(reference: cmd/vearch/startup.go:112-120 role tags, CI standalone env).
+Used by tests and the quickstart; production runs the roles as separate
+processes on separate hosts with the same classes.
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+from vearch_tpu.cluster.master import MasterServer
+from vearch_tpu.cluster.ps import PSServer
+from vearch_tpu.cluster.router import RouterServer
+
+
+class StandaloneCluster:
+    def __init__(self, data_dir: str | None = None, n_ps: int = 1):
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="vearch_tpu_")
+        self.master = MasterServer()
+        self.ps_nodes: list[PSServer] = []
+        self.router: RouterServer | None = None
+        self.n_ps = n_ps
+
+    def start(self) -> "StandaloneCluster":
+        self.master.start()
+        for i in range(self.n_ps):
+            ps = PSServer(
+                data_dir=f"{self.data_dir}/ps{i}",
+                master_addr=self.master.addr,
+            )
+            ps.start()
+            self.ps_nodes.append(ps)
+        self.router = RouterServer(master_addr=self.master.addr)
+        self.router.start()
+        return self
+
+    def stop(self) -> None:
+        if self.router:
+            self.router.stop()
+        for ps in self.ps_nodes:
+            ps.stop()
+        self.master.stop()
+
+    @property
+    def router_addr(self) -> str:
+        return self.router.addr
+
+    @property
+    def master_addr(self) -> str:
+        return self.master.addr
+
+    def __enter__(self) -> "StandaloneCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

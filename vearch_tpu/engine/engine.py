@@ -123,7 +123,15 @@ class Engine:
                     n += 1
         return n
 
-    def get(self, keys: list[str], fields: list[str] | None = None) -> list[dict]:
+    def get(
+        self,
+        keys: list[str],
+        fields: list[str] | None = None,
+        vector_value: bool = False,
+    ) -> list[dict]:
+        """Fetch docs by key. Vector payloads ride only when
+        `vector_value` is set or a vector field is named in `fields`
+        (reference: the `vector_value` request flag)."""
         out = []
         for key in keys:
             docid = self.table.docid_of(key)
@@ -131,7 +139,7 @@ class Engine:
                 continue
             doc = {"_id": key, **self.table.get_fields(docid, fields)}
             for name, store in self.vector_stores.items():
-                if fields is None or name in fields:
+                if vector_value or (fields is not None and name in fields):
                     doc[name] = store.get(docid).tolist()
             out.append(doc)
         return out
@@ -140,6 +148,37 @@ class Engine:
     def doc_count(self) -> int:
         """Alive docs (reference: engine status doc_num minus deletes)."""
         return self.table.doc_count - self.bitmap.deleted_count
+
+    def query(
+        self,
+        filters: Any = None,
+        limit: int = 50,
+        offset: int = 0,
+        include_fields: list[str] | None = None,
+        vector_value: bool = False,
+    ) -> list[dict]:
+        """Scalar-only query: filter docs without vector search
+        (reference: engine.cc:404 ScalarIndexQuery-only path +
+        /document/query). Vector payload rules match get()."""
+        n = self.table.doc_count
+        valid = self.bitmap.valid_mask(n)
+        if filters is not None:
+            from vearch_tpu.scalar.filter import evaluate_filter
+
+            valid = valid & evaluate_filter(filters, self, n)
+        hits = np.nonzero(valid)[0][offset : offset + limit]
+        out = []
+        for docid in hits:
+            docid = int(docid)
+            doc = {"_id": self.table.key_of(docid)}
+            doc.update(self.table.get_fields(docid, include_fields))
+            for name, store in self.vector_stores.items():
+                if vector_value or (
+                    include_fields is not None and name in include_fields
+                ):
+                    doc[name] = store.get(docid).tolist()
+            out.append(doc)
+        return out
 
     # -- index lifecycle -----------------------------------------------------
 

@@ -1,0 +1,141 @@
+"""Python SDK.
+
+Mirrors pyvearch's surface (reference: sdk/python/vearch/core/vearch.py:33
+`Vearch`, core/space.py:30 `Space` — create_database/create_space/upsert/
+search/query/delete against the router+master REST API).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from vearch_tpu.cluster import rpc
+
+
+class VearchClient:
+    def __init__(self, router_addr: str):
+        self.addr = router_addr.replace("http://", "")
+
+    # -- admin (proxied to master) -------------------------------------------
+
+    def create_database(self, db_name: str) -> dict:
+        return rpc.call(self.addr, "POST", f"/dbs/{db_name}")
+
+    def drop_database(self, db_name: str) -> dict:
+        return rpc.call(self.addr, "DELETE", f"/dbs/{db_name}")
+
+    def list_databases(self) -> list[dict]:
+        return rpc.call(self.addr, "GET", "/dbs")["dbs"]
+
+    def create_space(self, db_name: str, space_config: dict) -> dict:
+        """space_config: {name, fields: [...], partition_num, replica_num}
+        with fields in TableSchema.to_dict() form."""
+        return rpc.call(self.addr, "POST", f"/dbs/{db_name}/spaces", space_config)
+
+    def drop_space(self, db_name: str, space_name: str) -> dict:
+        return rpc.call(self.addr, "DELETE", f"/dbs/{db_name}/spaces/{space_name}")
+
+    def get_space(self, db_name: str, space_name: str) -> dict:
+        return rpc.call(self.addr, "GET", f"/dbs/{db_name}/spaces/{space_name}")
+
+    def list_spaces(self, db_name: str) -> list[dict]:
+        return rpc.call(self.addr, "GET", f"/dbs/{db_name}/spaces")["spaces"]
+
+    def is_live(self) -> bool:
+        try:
+            rpc.call(self.addr, "GET", "/cluster/health")
+            return True
+        except rpc.RpcError:
+            return False
+
+    # -- documents -----------------------------------------------------------
+
+    def upsert(self, db_name: str, space_name: str, documents: list[dict]) -> dict:
+        documents = [
+            {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+             for k, v in d.items()}
+            for d in documents
+        ]
+        return rpc.call(self.addr, "POST", "/document/upsert", {
+            "db_name": db_name, "space_name": space_name,
+            "documents": documents,
+        })
+
+    def search(
+        self,
+        db_name: str,
+        space_name: str,
+        vectors: list[dict[str, Any]],
+        limit: int = 10,
+        filters: dict | None = None,
+        fields: list[str] | None = None,
+        index_params: dict | None = None,
+        ranker: dict | None = None,
+    ) -> list[list[dict]]:
+        vectors = [
+            {**v, "feature": (
+                np.asarray(v["feature"], dtype=np.float32).ravel().tolist()
+            )}
+            for v in vectors
+        ]
+        body = {
+            "db_name": db_name, "space_name": space_name,
+            "vectors": vectors, "limit": limit,
+        }
+        if filters:
+            body["filters"] = filters
+        if fields is not None:
+            body["fields"] = fields
+        if index_params:
+            body["index_params"] = index_params
+        if ranker:
+            body["ranker"] = ranker
+        return rpc.call(self.addr, "POST", "/document/search", body)["documents"]
+
+    def query(
+        self,
+        db_name: str,
+        space_name: str,
+        document_ids: list[str] | None = None,
+        filters: dict | None = None,
+        limit: int = 50,
+        fields: list[str] | None = None,
+        vector_value: bool = False,
+    ) -> list[dict]:
+        body: dict[str, Any] = {"db_name": db_name, "space_name": space_name,
+                                "limit": limit, "vector_value": vector_value}
+        if document_ids:
+            body["document_ids"] = document_ids
+        if filters:
+            body["filters"] = filters
+        if fields is not None:
+            body["fields"] = fields
+        return rpc.call(self.addr, "POST", "/document/query", body)["documents"]
+
+    def delete(
+        self,
+        db_name: str,
+        space_name: str,
+        document_ids: list[str] | None = None,
+        filters: dict | None = None,
+    ) -> int:
+        body: dict[str, Any] = {"db_name": db_name, "space_name": space_name}
+        if document_ids:
+            body["document_ids"] = document_ids
+        if filters:
+            body["filters"] = filters
+        return rpc.call(self.addr, "POST", "/document/delete", body)["total"]
+
+    def flush(self, db_name: str, space_name: str) -> dict:
+        return rpc.call(self.addr, "POST", "/index/flush",
+                        {"db_name": db_name, "space_name": space_name})
+
+    def forcemerge(self, db_name: str, space_name: str) -> dict:
+        return rpc.call(self.addr, "POST", "/index/forcemerge",
+                        {"db_name": db_name, "space_name": space_name})
+
+    def rebuild(self, db_name: str, space_name: str) -> dict:
+        return rpc.call(self.addr, "POST", "/index/rebuild",
+                        {"db_name": db_name, "space_name": space_name})
