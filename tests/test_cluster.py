@@ -153,7 +153,7 @@ def test_upsert_updates_in_place(client, docs_and_vecs):
 
 
 def test_validation_errors(client):
-    with pytest.raises(Exception, match="dimension"):
+    with pytest.raises(Exception, match="length 17 != expected 16"):
         client.upsert("db1", "space1",
                       [{"_id": "bad", "title": "", "price": 0.0,
                         "emb": [0.0] * (D + 1)}])

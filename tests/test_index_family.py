@@ -1,0 +1,125 @@
+"""Per-index-type behavior tests for the long-tail index family
+(reference: test/test_vector_index_{hnsw,ivfrabitq}.py,
+test_vector_index_binary_ivf coverage)."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType,
+    FieldSchema,
+    IndexParams,
+    MetricType,
+    TableSchema,
+)
+from vearch_tpu.index.registry import registered_types
+
+
+def test_registry_has_full_family():
+    types = registered_types()
+    for t in ("FLAT", "IVFFLAT", "IVFPQ", "HNSW", "BINARYIVF", "IVFRABITQ"):
+        assert t in types, types
+
+
+def _mk_engine(index_type, d=32, metric=MetricType.L2, params=None):
+    schema = TableSchema(
+        name="fam",
+        fields=[FieldSchema("emb", DataType.VECTOR, dimension=d,
+                            index=IndexParams(index_type, metric, params or {}))],
+    )
+    return Engine(schema)
+
+
+def test_hnsw_search_and_ef_knob(rng):
+    eng = _mk_engine("HNSW", params={"efSearch": 32})
+    vecs = rng.standard_normal((500, 32)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "emb": vecs[i]} for i in range(500)])
+    res = eng.search(SearchRequest(vectors={"emb": vecs[:5]}, k=3))
+    assert [r.items[0].key for r in res] == [f"d{i}" for i in range(5)]
+    # scores are exact after rerank
+    assert res[0].items[0].score == pytest.approx(0.0, abs=1e-3)
+    # per-request efSearch override works
+    res = eng.search(SearchRequest(vectors={"emb": vecs[:1]}, k=3,
+                                   index_params={"efSearch": 500}))
+    assert res[0].items[0].key == "d0"
+
+
+def test_hnsw_cosine(rng):
+    eng = _mk_engine("HNSW", metric=MetricType.COSINE)
+    vecs = rng.standard_normal((300, 32)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "emb": vecs[i]} for i in range(300)])
+    res = eng.search(SearchRequest(vectors={"emb": vecs[7] * 3.0}, k=1))
+    assert res[0].items[0].key == "d7"  # scale-invariant
+    assert res[0].items[0].score == pytest.approx(1.0, abs=1e-2)
+
+
+def test_hnsw_delete_and_update(rng):
+    eng = _mk_engine("HNSW")
+    vecs = rng.standard_normal((100, 32)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "emb": vecs[i]} for i in range(100)])
+    eng.delete(["d5"])
+    res = eng.search(SearchRequest(vectors={"emb": vecs[5]}, k=5))
+    assert all(it.key != "d5" for it in res[0].items)
+
+
+def test_binaryivf_hamming(rng):
+    d = 64  # bits
+    eng = _mk_engine("BINARYIVF", d=d,
+                     params={"ncentroids": 8, "nprobe": 8,
+                             "training_threshold": 100})
+    bits = rng.integers(0, 2, (400, d)).astype(np.uint8)
+    packed = np.packbits(bits, axis=1)  # [400, 8] bytes
+    eng.upsert([{"_id": f"d{i}", "emb": packed[i]} for i in range(400)])
+    eng.wait_for_index()
+    eng.build_index()
+    res = eng.search(SearchRequest(vectors={"emb": packed[3]}, k=3))
+    assert res[0].items[0].key == "d3"
+    assert res[0].items[0].score == 0.0  # exact self-match: hamming 0
+    # reported score == true hamming distance for other hits
+    for it in res[0].items[1:]:
+        i = int(it.key[1:])
+        assert it.score == float((bits[3] != bits[i]).sum())
+
+
+def test_binaryivf_wire_dim_validation():
+    from vearch_tpu.engine.types import FieldSchema, IndexParams
+
+    f = FieldSchema("emb", DataType.VECTOR, dimension=64,
+                    index=IndexParams("BINARYIVF"))
+    assert f.wire_dim == 8
+    f2 = FieldSchema("emb", DataType.VECTOR, dimension=64,
+                     index=IndexParams("FLAT"))
+    assert f2.wire_dim == 64
+
+
+def test_ivfrabitq_recall_with_rerank(rng):
+    centers = rng.standard_normal((40, 32)).astype(np.float32) * 4
+    which = rng.integers(0, 40, 4000)
+    vecs = centers[which] + 0.5 * rng.standard_normal((4000, 32)).astype(np.float32)
+    eng = _mk_engine("IVFRABITQ",
+                     params={"ncentroids": 32, "training_threshold": 500})
+    eng.upsert([{"_id": f"d{i}", "emb": vecs[i]} for i in range(4000)])
+    eng.wait_for_index()
+    eng.build_index()
+    queries = vecs[rng.choice(4000, 30, replace=False)]
+    ref = np.argsort(((queries[:, None] - vecs[None]) ** 2).sum(-1), axis=1)[:, :10]
+    res = eng.search(SearchRequest(vectors={"emb": queries}, k=10))
+    hits = sum(
+        len({int(it.key[1:]) for it in r.items} & set(ref[qi].tolist()))
+        for qi, r in enumerate(res)
+    )
+    assert hits / (30 * 10) >= 0.8  # 1-bit quant + exact rerank
+
+
+def test_ivfrabitq_dump_load(rng, tmp_path):
+    vecs = np.random.default_rng(0).standard_normal((1200, 32)).astype(np.float32)
+    eng = _mk_engine("IVFRABITQ", params={"ncentroids": 16,
+                                          "training_threshold": 500})
+    eng.upsert([{"_id": f"d{i}", "emb": vecs[i]} for i in range(1200)])
+    eng.wait_for_index()
+    eng.build_index()
+    eng.dump(str(tmp_path / "rq"))
+    eng2 = Engine.open(str(tmp_path / "rq"))
+    res = eng2.search(SearchRequest(vectors={"emb": vecs[42:43]}, k=1))
+    assert res[0].items[0].key == "d42"

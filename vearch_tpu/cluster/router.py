@@ -159,11 +159,11 @@ class RouterServer:
                 v = doc.get(name)
                 if v is None:
                     raise RpcError(400, f"missing vector field {name!r}")
-                if len(v) != f.dimension:
+                if len(v) != f.wire_dim:
                     raise RpcError(
                         400,
                         f"vector field {name!r} length {len(v)} != "
-                        f"dimension {f.dimension}",
+                        f"expected {f.wire_dim}",
                     )
             for k in doc:
                 if k not in known:
@@ -177,19 +177,20 @@ class RouterServer:
         for v in body.get("vectors", []):
             f = space.schema.field(v["field"])
             feat = v["feature"]
-            if len(feat) % max(f.dimension, 1) != 0:
+            wd = max(f.wire_dim, 1)
+            if len(feat) % wd != 0:
                 raise RpcError(
                     400,
                     f"feature length {len(feat)} not divisible by "
-                    f"dimension {f.dimension}",
+                    f"dimension {wd}",
                 )
-            b = len(feat) // f.dimension
+            b = len(feat) // wd
             if nq is None:
                 nq = b
             elif nq != b:
                 raise RpcError(400, "inconsistent query batch across fields")
             out[v["field"]] = [
-                feat[i * f.dimension : (i + 1) * f.dimension] for i in range(b)
+                feat[i * wd : (i + 1) * wd] for i in range(b)
             ]
         if not out:
             raise RpcError(400, "search requires `vectors`")

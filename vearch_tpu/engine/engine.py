@@ -92,13 +92,15 @@ class Engine:
         vf = self.schema.vector_fields()
         keys: list[str] = []
         with self._write_lock:
-            # batch the vector appends: one host copy per field per call
-            mats = {
-                f.name: np.asarray(
-                    [d[f.name] for d in docs], dtype=np.float32
-                ).reshape(len(docs), f.dimension)
-                for f in vf
-            }
+            # batch the vector appends: one host copy per field per call;
+            # decode wire format (e.g. packed binary) via the index hook
+            mats = {}
+            for f in vf:
+                idx = self.indexes[f.name]
+                raw = np.asarray([d[f.name] for d in docs]).reshape(
+                    len(docs), idx.input_dim
+                )
+                mats[f.name] = idx.decode_input(raw)
             for i, doc in enumerate(docs):
                 key = str(doc["_id"]) if "_id" in doc else uuid.uuid4().hex
                 fields = {k: v for k, v in doc.items() if k != "_id"}
@@ -283,11 +285,14 @@ class Engine:
         queries_by_field: dict[str, np.ndarray] = {}
         fetch_k = req.k if len(req.vectors) == 1 else max(req.k * 4, 50)
         for name, queries in req.vectors.items():
-            queries = np.asarray(queries, dtype=np.float32)
+            index = self.indexes[name]
+            queries = np.asarray(queries)
             if queries.ndim == 1:
                 queries = queries[None, :]
+            queries = index.decode_input(
+                queries.reshape(queries.shape[0], index.input_dim)
+            )
             queries_by_field[name] = queries
-            index = self.indexes[name]
             store = self.vector_stores[name]
             use_index = index.trained and not req.brute_force
             if use_index:

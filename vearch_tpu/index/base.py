@@ -45,6 +45,17 @@ class VectorIndex(abc.ABC):
         # background build thread (reference: engine.cc CAS state machine)
         self._absorb_lock = threading.Lock()
 
+    @property
+    def input_dim(self) -> int:
+        """Wire-format vector length (binary indexes pack 8 bits/byte —
+        reference: faiss binary vectors are d/8 uint8)."""
+        return self.store.dimension
+
+    def decode_input(self, batch: np.ndarray) -> np.ndarray:
+        """Decode wire-format vectors [b, input_dim] into the stored
+        representation [b, dimension] (identity for float indexes)."""
+        return np.asarray(batch, dtype=np.float32)
+
     @abc.abstractmethod
     def search(
         self,

@@ -1,10 +1,7 @@
 """Imports that register the built-in index types (side-effect imports;
 reference: the static REGISTER_INDEX initialisers in index/impl/*.cc)."""
 
+import vearch_tpu.index.binary  # noqa: F401
 import vearch_tpu.index.flat  # noqa: F401
-
-# IVFFLAT / IVFPQ register here as they land:
-try:
-    import vearch_tpu.index.ivf  # noqa: F401
-except ImportError:  # pragma: no cover - during incremental build-out
-    pass
+import vearch_tpu.index.hnsw  # noqa: F401
+import vearch_tpu.index.ivf  # noqa: F401

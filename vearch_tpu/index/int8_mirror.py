@@ -1,0 +1,90 @@
+"""Docid-ordered int8 device mirror (shared by the scan-based indexes).
+
+Append-only host arrays (codes, per-row scale, squared norm) with a
+lazily-flushed device copy — the same tail-flush pattern as
+RawVectorStore.device_buffer, for quantized payloads. Rows are int8 per-
+row-scaled approximations; scoring dequantises inside the matmul kernel
+(ops/ivf.py int8_scan_candidates).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_rows(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-row symmetric int8 quantization; returns (q8, scale, vsq)."""
+    scale = np.maximum(np.abs(rows).max(axis=1) / 127.0, 1e-12).astype(
+        np.float32
+    )
+    q8 = np.clip(np.rint(rows / scale[:, None]), -127, 127).astype(np.int8)
+    deq = q8.astype(np.float32) * scale[:, None]
+    vsq = np.sum(deq * deq, axis=1).astype(np.float32)
+    return q8, scale, vsq
+
+
+class Int8Mirror:
+    def __init__(self, dimension: int):
+        self.dimension = dimension
+        self._h8 = np.zeros((0, dimension), dtype=np.int8)
+        self._h_scale = np.zeros(0, dtype=np.float32)
+        self._h_vsq = np.zeros(0, dtype=np.float32)
+        self._n = 0
+        self._d8: jax.Array | None = None
+        self._d_scale: jax.Array | None = None
+        self._d_vsq: jax.Array | None = None
+        self._d_rows = 0
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def append_quantized(
+        self, q8: np.ndarray, scale: np.ndarray, vsq: np.ndarray,
+        start: int | None = None,
+    ) -> None:
+        """Write rows at [start, start+b) (default: append at count)."""
+        start = self._n if start is None else start
+        need = start + q8.shape[0]
+        if self._h8.shape[0] < need:
+            cap = max(need, self._h8.shape[0] * 2, 1024)
+            g8 = np.zeros((cap, self.dimension), dtype=np.int8)
+            gs = np.zeros(cap, dtype=np.float32)
+            gv = np.zeros(cap, dtype=np.float32)
+            g8[: self._n] = self._h8[: self._n]
+            gs[: self._n] = self._h_scale[: self._n]
+            gv[: self._n] = self._h_vsq[: self._n]
+            self._h8, self._h_scale, self._h_vsq = g8, gs, gv
+        sl = slice(start, need)
+        self._h8[sl] = q8
+        self._h_scale[sl] = scale
+        self._h_vsq[sl] = vsq
+        self._n = max(self._n, need)
+
+    def append(self, rows: np.ndarray, start: int | None = None) -> None:
+        self.append_quantized(*quantize_rows(rows), start=start)
+
+    def flush(self) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """Device views [cap, d] / [cap] / [cap]; rows >= count are padding."""
+        n = self._n
+        cap = self._h8.shape[0]
+        if self._d8 is None or self._d8.shape[0] != cap:
+            self._d8 = jnp.asarray(self._h8)
+            self._d_scale = jnp.asarray(self._h_scale)
+            self._d_vsq = jnp.asarray(self._h_vsq)
+            self._d_rows = n
+        elif self._d_rows < n:
+            sl = slice(self._d_rows, n)
+            self._d8 = jax.lax.dynamic_update_slice(
+                self._d8, jnp.asarray(self._h8[sl]), (self._d_rows, 0)
+            )
+            self._d_scale = jax.lax.dynamic_update_slice(
+                self._d_scale, jnp.asarray(self._h_scale[sl]), (self._d_rows,)
+            )
+            self._d_vsq = jax.lax.dynamic_update_slice(
+                self._d_vsq, jnp.asarray(self._h_vsq[sl]), (self._d_rows,)
+            )
+            self._d_rows = n
+        return self._d8, self._d_scale, self._d_vsq

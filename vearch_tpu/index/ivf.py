@@ -32,6 +32,7 @@ import numpy as np
 from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.engine.types import IndexParams, MetricType
 from vearch_tpu.index.base import VectorIndex
+from vearch_tpu.index.int8_mirror import Int8Mirror
 from vearch_tpu.index.registry import register_index
 from vearch_tpu.ops import ivf as ivf_ops
 from vearch_tpu.ops import kmeans as km
@@ -275,13 +276,7 @@ class IVFPQIndex(_IVFBase):
         self._bucket_scale: jax.Array | None = None
         self._bucket_vsq: jax.Array | None = None
         # full-scan-mode state (docid-ordered int8 mirror, append-only)
-        self._h_approx8 = np.zeros((0, store.dimension), dtype=np.int8)
-        self._h_scale = np.zeros(0, dtype=np.float32)
-        self._h_vsq = np.zeros(0, dtype=np.float32)
-        self._d_approx8: jax.Array | None = None
-        self._d_scale: jax.Array | None = None
-        self._d_vsq: jax.Array | None = None
-        self._d_rows = 0
+        self._mirror = Int8Mirror(store.dimension)
 
     def _train_extra(self, sample: np.ndarray) -> None:
         assign = np.asarray(
@@ -317,49 +312,7 @@ class IVFPQIndex(_IVFBase):
             np.arange(self.m)[None, :], codes.astype(np.int64), :
         ].reshape(rows.shape[0], -1)
         approx = cents[assign] + decoded
-        scale = np.maximum(np.abs(approx).max(axis=1) / 127.0, 1e-12).astype(
-            np.float32
-        )
-        q8 = np.clip(np.rint(approx / scale[:, None]), -127, 127).astype(np.int8)
-        deq = q8.astype(np.float32) * scale[:, None]
-        vsq = np.sum(deq * deq, axis=1).astype(np.float32)
-        if self._h_approx8.shape[0] < need:
-            cap = max(need, self._h_approx8.shape[0] * 2, 1024)
-            g8 = np.zeros((cap, self.store.dimension), dtype=np.int8)
-            gs = np.zeros(cap, dtype=np.float32)
-            gv = np.zeros(cap, dtype=np.float32)
-            g8[: self._h_approx8.shape[0]] = self._h_approx8[: self._h_approx8.shape[0]]
-            gs[: self._h_scale.shape[0]] = self._h_scale
-            gv[: self._h_vsq.shape[0]] = self._h_vsq
-            self._h_approx8, self._h_scale, self._h_vsq = g8, gs, gv
-        sl = slice(start_docid, start_docid + rows.shape[0])
-        self._h_approx8[sl] = q8
-        self._h_scale[sl] = scale
-        self._h_vsq[sl] = vsq
-
-    def _flush_full_scan(self) -> tuple[jax.Array, jax.Array, jax.Array]:
-        """Device mirror of the docid-ordered int8 arrays (same lazy-tail
-        flush pattern as RawVectorStore.device_buffer)."""
-        n = self.indexed_count
-        cap = self._h_approx8.shape[0]
-        if self._d_approx8 is None or self._d_approx8.shape[0] != cap:
-            self._d_approx8 = jnp.asarray(self._h_approx8)
-            self._d_scale = jnp.asarray(self._h_scale)
-            self._d_vsq = jnp.asarray(self._h_vsq)
-            self._d_rows = n
-        elif self._d_rows < n:
-            sl = slice(self._d_rows, n)
-            self._d_approx8 = jax.lax.dynamic_update_slice(
-                self._d_approx8, jnp.asarray(self._h_approx8[sl]), (self._d_rows, 0)
-            )
-            self._d_scale = jax.lax.dynamic_update_slice(
-                self._d_scale, jnp.asarray(self._h_scale[sl]), (self._d_rows,)
-            )
-            self._d_vsq = jax.lax.dynamic_update_slice(
-                self._d_vsq, jnp.asarray(self._h_vsq[sl]), (self._d_rows,)
-            )
-            self._d_rows = n
-        return self._d_approx8, self._d_scale, self._d_vsq
+        self._mirror.append(approx, start=start_docid)
 
     def _publish(self) -> None:
         """Decode PQ codes -> residual approximations -> int8 buckets.
@@ -414,7 +367,7 @@ class IVFPQIndex(_IVFBase):
         if mode == "auto":
             mode = "full" if self.indexed_count <= self.full_scan_limit else "probe"
         if mode == "full":
-            approx8, scale, vsq = self._flush_full_scan()
+            approx8, scale, vsq = self._mirror.flush()
             n_pad = approx8.shape[0]
             valid = to_device_mask(valid_mask, self.indexed_count, n_pad)
             r = min(self._rerank_depth(k, params), max(self.indexed_count, 1))
