@@ -1,0 +1,114 @@
+"""Replication + failover tests (reference: test/test_cluster_ps.py —
+docker stop of PS containers, test_ps_recover:126; here the PS server
+object is stopped in-process, same observable behavior)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.master import MasterServer
+from vearch_tpu.cluster.ps import PSServer
+from vearch_tpu.cluster.router import RouterServer
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture
+def repl_cluster(tmp_path):
+    master = MasterServer(heartbeat_ttl=1.5)
+    master.start()
+    ps_nodes = []
+    for i in range(3):
+        ps = PSServer(data_dir=str(tmp_path / f"ps{i}"),
+                      master_addr=master.addr, heartbeat_interval=0.3)
+        ps.start()
+        ps_nodes.append(ps)
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    yield master, ps_nodes, router
+    router.stop()
+    for ps in ps_nodes:
+        try:
+            ps.stop()
+        except Exception:
+            pass
+    master.stop()
+
+
+def test_replicated_write_and_failover(repl_cluster, rng):
+    master, ps_nodes, router = repl_cluster
+    cl = VearchClient(router.addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2, "replica_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((40, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]} for i in range(40)])
+
+    # every replica holds the writes (leader forwarded synchronously)
+    sp = cl.get_space("db", "s")
+    per_partition_counts: dict[int, set[int]] = {}
+    for part in sp["partitions"]:
+        counts = set()
+        for ps in ps_nodes:
+            if part["id"] in ps.engines:
+                counts.add(ps.engines[part["id"]].doc_count)
+        assert len(counts) == 1, f"replica divergence: {counts}"
+        per_partition_counts[part["id"]] = counts
+
+    # kill the leader of partition 0
+    dead_node = sp["partitions"][0]["leader"]
+    dead_ps = next(p for p in ps_nodes if p.node_id == dead_node)
+    dead_ps.stop()
+
+    # wait for lease expiry + failover
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        sp2 = cl.get_space("db", "s")
+        if all(p["leader"] != dead_node or
+               len([r for r in p["replicas"] if r != dead_node]) == 0
+               for p in sp2["partitions"]):
+            if any(p["leader"] != sp["partitions"][i]["leader"]
+                   for i, p in enumerate(sp2["partitions"])):
+                break
+        time.sleep(0.3)
+
+    # searches still see the full corpus through promoted leaders
+    hits = cl.search("db", "s", [{"field": "v", "feature": vecs[5]}], limit=1)
+    assert hits[0][0]["_id"] == "d5"
+    hits = cl.search("db", "s", [{"field": "v", "feature": vecs[17]}], limit=1)
+    assert hits[0][0]["_id"] == "d17"
+
+    # writes keep working after failover
+    new = rng.standard_normal(D).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": "post_fail", "v": new}])
+    hits = cl.search("db", "s", [{"field": "v", "feature": new}], limit=1)
+    assert hits[0][0]["_id"] == "post_fail"
+
+    # the master recorded the failure durably
+    fails = master.store.prefix("/fail_server/")
+    assert any(v["node_id"] == dead_node for v in fails.values())
+
+
+def test_delete_replicates(repl_cluster, rng):
+    master, ps_nodes, router = repl_cluster
+    cl = VearchClient(router.addr)
+    cl.create_database("db2")
+    cl.create_space("db2", {
+        "name": "s", "partition_num": 1, "replica_num": 3,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((10, D)).astype(np.float32)
+    cl.upsert("db2", "s", [{"_id": f"d{i}", "v": vecs[i]} for i in range(10)])
+    cl.delete("db2", "s", document_ids=["d3"])
+    pid = cl.get_space("db2", "s")["partitions"][0]["id"]
+    for ps in ps_nodes:
+        if pid in ps.engines:
+            assert ps.engines[pid].doc_count == 9

@@ -39,7 +39,9 @@ class MasterServer:
         host: str = "127.0.0.1",
         port: int = 0,
         persist_path: str | None = None,
+        heartbeat_ttl: float = HEARTBEAT_TTL,
     ):
+        self.heartbeat_ttl = heartbeat_ttl
         self.store = MetaStore(persist_path)
         self._stop = threading.Event()
         self._leases: dict[int, int] = {}  # node_id -> lease id
@@ -69,16 +71,35 @@ class MasterServer:
     # -- failure detection (reference: master_cache.go:963-1005) -------------
 
     def _lease_reaper(self) -> None:
+        tick = min(1.0, self.heartbeat_ttl / 4)
         while not self._stop.is_set():
-            time.sleep(1.0)
+            time.sleep(tick)
             for key in self.store.expire_leases():
                 if key.startswith(PREFIX_SERVER):
-                    # durable FailServer record; auto-recovery re-places
-                    # replicas in a later round (services/server_service.go:95)
-                    node_id = key[len(PREFIX_SERVER):]
+                    # durable FailServer record (reference: master_cache.go
+                    # :963-1005 FailServer) + immediate leader failover
+                    node_id = int(key[len(PREFIX_SERVER):])
                     self.store.put(f"/fail_server/{node_id}", {
-                        "node_id": int(node_id), "time": time.time(),
+                        "node_id": node_id, "time": time.time(),
                     })
+                    self._failover_node(node_id)
+
+    def _failover_node(self, dead_node: int) -> None:
+        """Promote the first alive follower of every partition the dead
+        node led (reference: auto-recover re-placement,
+        services/server_service.go:95 — raft elects; here the master
+        promotes since replication v0 is primary-backup)."""
+        alive = {s.node_id for s in self._alive_servers()}
+        for key, sp in self.store.prefix(PREFIX_SPACE).items():
+            changed = False
+            for p in sp["partitions"]:
+                if p["leader"] == dead_node:
+                    candidates = [r for r in p["replicas"] if r in alive]
+                    if candidates:
+                        p["leader"] = candidates[0]
+                        changed = True
+            if changed:
+                self.store.put(key, sp)
 
     # -- servers -------------------------------------------------------------
 
@@ -95,8 +116,8 @@ class MasterServer:
             partition_ids=(existing or {}).get("partition_ids", []),
         )
         lease = self._leases.get(node_id)
-        if lease is None or not self.store.keepalive(lease, HEARTBEAT_TTL):
-            lease = self.store.grant_lease(HEARTBEAT_TTL)
+        if lease is None or not self.store.keepalive(lease, self.heartbeat_ttl):
+            lease = self.store.grant_lease(self.heartbeat_ttl)
             self._leases[node_id] = lease
         self.store.put(key, server.to_dict(), lease=lease)
         self.store.delete(f"/fail_server/{node_id}")

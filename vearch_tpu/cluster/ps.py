@@ -141,20 +141,65 @@ class PSServer:
         )
         return {"partition_id": pid}
 
+    # -- replication v0 (primary-backup) -------------------------------------
+    # The leader applies a write locally, then forwards it synchronously to
+    # every follower replica before acking (the reference replicates through
+    # a raft log, raftstore/store_writer.go:77; a log-structured raft sits
+    # here in a later round — the fan-out seam is identical).
+
+    def _peer_addrs(self, pid: int) -> list[str]:
+        part = self.partitions.get(pid)
+        if part is None or self.master_addr is None:
+            return []
+        if part.leader != self.node_id:
+            return []
+        peers = [r for r in part.replicas if r != self.node_id]
+        if not peers:
+            return []
+        try:
+            servers = rpc.call(self.master_addr, "GET", "/servers")["servers"]
+        except RpcError:
+            return []
+        by_id = {s["node_id"]: s["rpc_addr"] for s in servers}
+        return [by_id[p] for p in peers if p in by_id]
+
+    def _replicate(self, pid: int, path: str, body: dict) -> None:
+        for addr in self._peer_addrs(pid):
+            try:
+                rpc.call(addr, "POST", path, {**body, "replicated": True})
+            except RpcError:
+                # follower write failure: the replica is stale until
+                # re-sync; the master's failure detector owns membership
+                pass
+
     def _h_upsert(self, body: dict, _parts) -> dict:
-        eng = self._engine(body["partition_id"])
+        pid = int(body["partition_id"])
+        eng = self._engine(pid)
         keys = eng.upsert(body["documents"])
+        if not body.get("replicated"):
+            self._replicate(pid, "/ps/doc/upsert",
+                            {"partition_id": pid,
+                             "documents": body["documents"]})
         return {"keys": keys, "count": len(keys)}
 
     def _h_delete(self, body: dict, _parts) -> dict:
-        eng = self._engine(body["partition_id"])
+        pid = int(body["partition_id"])
+        eng = self._engine(pid)
         if body.get("keys"):
-            return {"deleted": eng.delete(body["keys"])}
+            deleted = eng.delete(body["keys"])
+            if not body.get("replicated"):
+                self._replicate(pid, "/ps/doc/delete",
+                                {"partition_id": pid, "keys": body["keys"]})
+            return {"deleted": deleted}
         # delete-by-filter (reference: /document/delete with filters)
         docs = eng.query(body.get("filters"), limit=body.get("limit", 10_000),
                          include_fields=[])
         keys = [d["_id"] for d in docs]
-        return {"deleted": eng.delete(keys), "keys": keys}
+        deleted = eng.delete(keys)
+        if keys and not body.get("replicated"):
+            self._replicate(pid, "/ps/doc/delete",
+                            {"partition_id": pid, "keys": keys})
+        return {"deleted": deleted, "keys": keys}
 
     def _h_get(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])

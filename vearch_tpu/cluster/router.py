@@ -100,6 +100,29 @@ class RouterServer:
             raise RpcError(503, f"no server for partition {partition_id}")
         return srv.rpc_addr
 
+    def _invalidate_caches(self) -> None:
+        with self._cache_lock:
+            self._space_cache.clear()
+            self._server_cache = (0.0, {})
+
+    def _call_partition(self, space_key: tuple[str, str], pid: int,
+                        path: str, body: dict):
+        """RPC to a partition's leader with one failover retry: an
+        unreachable leader triggers a metadata refresh (the master may
+        have promoted a replica) and a second attempt (reference:
+        client.go:433-447 replica failover retry loop)."""
+        space = self._space(*space_key)
+        try:
+            return rpc.call(self._partition_addr(space, pid), "POST", path,
+                            {**body, "partition_id": pid})
+        except RpcError as e:
+            if e.code != -1:
+                raise
+            self._invalidate_caches()
+            space = self._space(*space_key)
+            return rpc.call(self._partition_addr(space, pid), "POST", path,
+                            {**body, "partition_id": pid})
+
     def _proxy_master(self, method: str, prefix: str):
         def h(body, parts):
             path = prefix + ("/" + "/".join(parts) if parts else "")
@@ -130,15 +153,14 @@ class RouterServer:
         return by_partition
 
     def _h_upsert(self, body: dict, _parts) -> dict:
-        space = self._space(body["db_name"], body["space_name"])
+        skey = (body["db_name"], body["space_name"])
+        space = self._space(*skey)
         self._validate_docs(space, body["documents"])
         by_partition = self._route_docs(space, body["documents"])
 
         def send(pid: int, docs: list[dict]):
-            return rpc.call(
-                self._partition_addr(space, pid), "POST", "/ps/doc/upsert",
-                {"partition_id": pid, "documents": docs},
-            )
+            return self._call_partition(skey, pid, "/ps/doc/upsert",
+                                        {"documents": docs})
 
         futures = [
             self._pool.submit(send, pid, docs)
@@ -197,7 +219,8 @@ class RouterServer:
         return out
 
     def _h_search(self, body: dict, _parts) -> dict:
-        space = self._space(body["db_name"], body["space_name"])
+        skey = (body["db_name"], body["space_name"])
+        space = self._space(*skey)
         vectors = self._parse_vectors(space, body)
         k = int(body.get("limit", body.get("topn", 10)))
         sub = {
@@ -213,10 +236,7 @@ class RouterServer:
         }
 
         def send(pid: int):
-            return rpc.call(
-                self._partition_addr(space, pid), "POST", "/ps/doc/search",
-                {**sub, "partition_id": pid},
-            )
+            return self._call_partition(skey, pid, "/ps/doc/search", sub)
 
         futures = [
             self._pool.submit(send, p.id) for p in space.partitions
@@ -246,7 +266,8 @@ class RouterServer:
         return out
 
     def _h_query(self, body: dict, _parts) -> dict:
-        space = self._space(body["db_name"], body["space_name"])
+        skey = (body["db_name"], body["space_name"])
+        space = self._space(*skey)
         if body.get("document_ids"):
             starts = space.slot_starts()
             by_partition: dict[int, list[str]] = {}
@@ -256,12 +277,10 @@ class RouterServer:
                 by_partition.setdefault(pid, []).append(str(key))
 
             def send(pid: int, keys: list[str]):
-                return rpc.call(
-                    self._partition_addr(space, pid), "POST", "/ps/doc/query",
-                    {"partition_id": pid, "document_ids": keys,
-                     "fields": body.get("fields"),
-                     "vector_value": body.get("vector_value", False)},
-                )
+                return self._call_partition(
+                    skey, pid, "/ps/doc/query",
+                    {"document_ids": keys, "fields": body.get("fields"),
+                     "vector_value": body.get("vector_value", False)})
 
             futures = [
                 self._pool.submit(send, pid, keys)
@@ -275,13 +294,12 @@ class RouterServer:
         limit = int(body.get("limit", 50))
 
         def send_filter(pid: int):
-            return rpc.call(
-                self._partition_addr(space, pid), "POST", "/ps/doc/query",
-                {"partition_id": pid, "filters": body.get("filters"),
-                 "limit": limit, "offset": int(body.get("offset", 0)),
+            return self._call_partition(
+                skey, pid, "/ps/doc/query",
+                {"filters": body.get("filters"), "limit": limit,
+                 "offset": int(body.get("offset", 0)),
                  "fields": body.get("fields"),
-                 "vector_value": body.get("vector_value", False)},
-            )
+                 "vector_value": body.get("vector_value", False)})
 
         futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
         docs = []
@@ -290,7 +308,8 @@ class RouterServer:
         return {"total": len(docs), "documents": docs[:limit]}
 
     def _h_delete(self, body: dict, _parts) -> dict:
-        space = self._space(body["db_name"], body["space_name"])
+        skey = (body["db_name"], body["space_name"])
+        space = self._space(*skey)
         if body.get("document_ids"):
             starts = space.slot_starts()
             by_partition: dict[int, list[str]] = {}
@@ -300,10 +319,8 @@ class RouterServer:
                 by_partition.setdefault(pid, []).append(str(key))
 
             def send(pid: int, keys: list[str]):
-                return rpc.call(
-                    self._partition_addr(space, pid), "POST", "/ps/doc/delete",
-                    {"partition_id": pid, "keys": keys},
-                )
+                return self._call_partition(skey, pid, "/ps/doc/delete",
+                                            {"keys": keys})
 
             futures = [
                 self._pool.submit(send, pid, keys)
@@ -312,11 +329,10 @@ class RouterServer:
             return {"total": sum(f.result()["deleted"] for f in futures)}
 
         def send_filter(pid: int):
-            return rpc.call(
-                self._partition_addr(space, pid), "POST", "/ps/doc/delete",
-                {"partition_id": pid, "filters": body.get("filters"),
-                 "limit": int(body.get("limit", 10_000))},
-            )
+            return self._call_partition(
+                skey, pid, "/ps/doc/delete",
+                {"filters": body.get("filters"),
+                 "limit": int(body.get("limit", 10_000))})
 
         futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
         return {"total": sum(f.result()["deleted"] for f in futures)}
@@ -324,13 +340,11 @@ class RouterServer:
     # -- index ops (reference: doc_http.go /index/{flush,forcemerge,rebuild})
 
     def _index_op(self, body: dict, ps_path: str) -> dict:
-        space = self._space(body["db_name"], body["space_name"])
+        skey = (body["db_name"], body["space_name"])
+        space = self._space(*skey)
 
         def send(pid: int):
-            return rpc.call(
-                self._partition_addr(space, pid), "POST", ps_path,
-                {"partition_id": pid},
-            )
+            return self._call_partition(skey, pid, ps_path, {})
 
         futures = [self._pool.submit(send, p.id) for p in space.partitions]
         return {"partitions": [f.result() for f in futures]}

@@ -143,6 +143,9 @@ def call(
             raise RpcError(e.code, str(e)) from e
     except urllib.error.URLError as e:
         raise RpcError(-1, f"unreachable {addr}: {e}") from e
+    except OSError as e:
+        # connection reset mid-read surfaces as a bare OSError, not URLError
+        raise RpcError(-1, f"unreachable {addr}: {e}") from e
     if payload.get("code", 0) != 0:
         raise RpcError(payload["code"], payload.get("msg", "rpc error"))
     return payload.get("data")
