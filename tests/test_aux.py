@@ -1,0 +1,105 @@
+"""Aux subsystems: metrics endpoint, trace breakdown, runtime config,
+realtime refresh loop (reference: monitor/, trace:true, /config API,
+engine.cc Indexing loop)."""
+
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("aux")), n_ps=1
+    )
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((50, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]} for i in range(50)])
+    yield c, cl, vecs
+    c.stop()
+
+
+def test_metrics_endpoint_all_roles(cluster):
+    c, cl, vecs = cluster
+    for addr in (c.router_addr, c.master_addr, c.ps_nodes[0].addr):
+        with urllib.request.urlopen(f"http://{addr}/metrics") as r:
+            text = r.read().decode()
+        assert "vearch_request_total" in text
+        assert "vearch_request_duration_seconds_bucket" in text
+    # router recorded the document routes with status labels
+    with urllib.request.urlopen(f"http://{c.router_addr}/metrics") as r:
+        text = r.read().decode()
+    assert '/document/upsert' in text
+
+
+def test_trace_returns_per_partition_timing(cluster):
+    c, cl, vecs = cluster
+    out = rpc.call(c.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s",
+        "vectors": [{"field": "v", "feature": vecs[3].tolist()}],
+        "limit": 2, "trace": True,
+    })
+    assert out["documents"][0][0]["_id"] == "d3"
+    assert "params" in out
+    (pid, timing), = out["params"].items()
+    assert timing["rpc_ms"] > 0
+    assert timing["total_ms"] > 0
+    assert timing["doc_count"] == 50
+
+
+def test_runtime_config_roundtrip(cluster):
+    c, cl, vecs = cluster
+    out = rpc.call(c.master_addr, "POST", "/config/db/s",
+                   {"refresh_interval_ms": 200, "training_threshold": 123})
+    assert out["applied"][0]["refresh_interval_ms"] == 200
+    got = rpc.call(c.master_addr, "GET", "/config/db/s")
+    assert got["training_threshold"] == 123
+    eng = next(iter(c.ps_nodes[0].engines.values()))
+    assert eng.schema.refresh_interval_ms == 200
+
+
+def test_refresh_loop_absorbs_in_background(rng):
+    from vearch_tpu.engine.engine import Engine
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+
+    schema = TableSchema(
+        "rt",
+        fields=[FieldSchema("v", DataType.VECTOR, dimension=D,
+                            index=IndexParams("IVFFLAT", MetricType.L2,
+                                              {"ncentroids": 8,
+                                               "training_threshold": 100}))],
+        refresh_interval_ms=60,
+    )
+    eng = Engine(schema)
+    eng.start_refresh_loop()
+    vecs = rng.standard_normal((300, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "v": vecs[i]} for i in range(300)])
+    eng.wait_for_index(timeout=60)
+    # new docs absorbed by the loop, without any search triggering it
+    more = rng.standard_normal((20, D)).astype(np.float32)
+    eng.upsert([{"_id": f"x{i}", "v": more[i]} for i in range(20)])
+    deadline = time.time() + 5
+    idx = eng.indexes["v"]
+    while time.time() < deadline and idx.indexed_count < 320:
+        time.sleep(0.05)
+    assert idx.indexed_count == 320
+    eng.close()

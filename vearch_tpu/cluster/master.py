@@ -55,6 +55,8 @@ class MasterServer:
         s.route("GET", "/dbs", self._h_get_db)
         s.route("DELETE", "/dbs", self._h_delete_db)
         s.route("GET", "/partitions", self._h_partitions)
+        s.route("POST", "/config", self._h_set_config)
+        s.route("GET", "/config", self._h_get_config)
 
     def start(self) -> None:
         self.server.start()
@@ -187,6 +189,38 @@ class MasterServer:
             "version": "0.1.0",
             "status": "green" if self._alive_servers() else "yellow",
         }
+
+    # -- runtime config (reference: cluster_api.go:294-307 modifySpaceConfig)
+
+    def _h_set_config(self, body: dict, parts) -> dict:
+        if len(parts) != 2:
+            raise RpcError(404, "POST /config/{db}/{space}")
+        db, name = parts
+        sp = self.store.get(f"{PREFIX_SPACE}{db}/{name}")
+        if sp is None:
+            raise RpcError(404, f"space {db}/{name} not found")
+        self.store.put(f"/config/{db}/{name}", body)
+        space = Space.from_dict(sp)
+        servers = {s.node_id: s for s in self._alive_servers()}
+        applied = []
+        for part in space.partitions:
+            for node_id in part.replicas:
+                srv = servers.get(node_id)
+                if srv is None:
+                    continue
+                try:
+                    applied.append(rpc.call(
+                        srv.rpc_addr, "POST", "/ps/engine/config",
+                        {"partition_id": part.id, "config": body},
+                    ))
+                except RpcError:
+                    pass
+        return {"applied": applied}
+
+    def _h_get_config(self, _body, parts) -> dict:
+        if len(parts) != 2:
+            raise RpcError(404, "GET /config/{db}/{space}")
+        return self.store.get(f"/config/{parts[0]}/{parts[1]}") or {}
 
     # -- space create (reference: services/space_service.go:59) --------------
 

@@ -1,0 +1,134 @@
+"""Prometheus-format metrics (dependency-free).
+
+TPU-native stand-in for the reference's monitor package (reference:
+internal/monitor/monitor_service.go:77 Register — request duration/count
+histograms labelled by op/code, cluster gauges, /metrics on every role).
+Counter/Gauge/Histogram with label support, rendered in the Prometheus
+text exposition format; every JsonRpcServer mounts a /metrics route and
+auto-instruments request count + latency per (method, path, code).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{v}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name, self.help, self.labels = name, help_, labels
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[lv] = self._values.get(lv, 0.0) + by
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        for lv, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(self.labels, lv)} {v}")
+        return "\n".join(lines)
+
+
+class Gauge(Counter):
+    def set(self, value: float, *label_values: str) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[lv] = value
+
+    def render(self) -> str:
+        return super().render().replace(" counter", " gauge", 1)
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        labels: tuple[str, ...] = (),
+        buckets: Iterable[float] = _DEFAULT_BUCKETS,
+    ):
+        self.name, self.help, self.labels = name, help_, labels
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, *label_values: str) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            counts = self._counts.setdefault(lv, [0] * (len(self.buckets) + 1))
+            self._sums[lv] = self._sums.get(lv, 0.0) + value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for lv, counts in sorted(self._counts.items()):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.labels + ('le',), lv + (str(b),))} {cum}"
+                )
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(self.labels + ('le',), lv + ('+Inf',))} "
+                f"{counts[-1]}"
+            )
+            lines.append(
+                f"{self.name}_sum{_fmt_labels(self.labels, lv)} "
+                f"{self._sums[lv]}"
+            )
+            lines.append(
+                f"{self.name}_count{_fmt_labels(self.labels, lv)} {counts[-1]}"
+            )
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_, labels=()) -> Counter:
+        m = Counter(name, help_, labels)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name, help_, labels=()) -> Gauge:
+        m = Gauge(name, help_, labels)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, name, help_, labels=(), buckets=_DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help_, labels, buckets)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        with self._lock:
+            return "\n".join(m.render() for m in self._metrics) + "\n"

@@ -53,6 +53,7 @@ class PSServer:
         s.route("POST", "/ps/index/build", self._h_build)
         s.route("POST", "/ps/index/rebuild", self._h_rebuild)
         s.route("POST", "/ps/flush", self._h_flush)
+        s.route("POST", "/ps/engine/config", self._h_engine_config)
         s.route("GET", "/ps/stats", self._h_stats)
 
     # -- lifecycle -----------------------------------------------------------
@@ -67,6 +68,8 @@ class PSServer:
 
     def stop(self) -> None:
         self._stop.set()
+        for eng in self.engines.values():
+            eng.close()
         self.server.stop()
 
     @property
@@ -106,7 +109,9 @@ class PSServer:
             if name.startswith("partition_") and os.path.isdir(p):
                 pid = int(name.split("_")[1])
                 try:
-                    self.engines[pid] = Engine.open(p)
+                    eng = Engine.open(p)
+                    eng.start_refresh_loop()
+                    self.engines[pid] = eng
                 except Exception:
                     continue
 
@@ -125,7 +130,9 @@ class PSServer:
                 raise RpcError(409, f"partition {pid} already exists")
             schema = TableSchema.from_dict(body["schema"])
             data_dir = os.path.join(self.data_dir, f"partition_{pid}")
-            self.engines[pid] = Engine(schema, data_dir=data_dir)
+            eng = Engine(schema, data_dir=data_dir)
+            eng.start_refresh_loop()
+            self.engines[pid] = eng
             self.partitions[pid] = Partition.from_dict(body["partition"])
         return {"partition_id": pid}
 
@@ -214,6 +221,7 @@ class PSServer:
             name: np.asarray(v, dtype=np.float32)
             for name, v in body["vectors"].items()
         }
+        trace = {} if body.get("trace") else None
         req = SearchRequest(
             vectors=vectors,
             k=int(body.get("k", 10)),
@@ -222,10 +230,11 @@ class PSServer:
             brute_force=bool(body.get("brute_force", False)),
             field_weights=body.get("field_weights") or {},
             index_params=body.get("index_params") or {},
+            trace=trace,
         )
         results = eng.search(req)
         metric = eng.indexes[next(iter(vectors))].metric.value
-        return {
+        out = {
             "metric": metric,
             "results": [
                 [
@@ -235,6 +244,9 @@ class PSServer:
                 for r in results
             ],
         }
+        if trace is not None:
+            out["timing"] = trace
+        return out
 
     def _h_query(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])
@@ -265,6 +277,10 @@ class PSServer:
         eng = self._engine(body["partition_id"])
         eng.dump()
         return {"doc_count": eng.doc_count}
+
+    def _h_engine_config(self, body: dict, _parts) -> dict:
+        eng = self._engine(body["partition_id"])
+        return eng.apply_config(body.get("config") or {})
 
     def _h_stats(self, _body, _parts) -> dict:
         return {

@@ -229,6 +229,7 @@ class RouterServer:
             "filters": body.get("filters"),
             "include_fields": body.get("fields"),
             "index_params": body.get("index_params") or {},
+            "trace": bool(body.get("trace", False)),
             "field_weights": {
                 r["field"]: r["weight"]
                 for r in body.get("ranker", {}).get("params", [])
@@ -238,12 +239,29 @@ class RouterServer:
         def send(pid: int):
             return self._call_partition(skey, pid, "/ps/doc/search", sub)
 
+        import time as _time
+
+        def timed(pid):
+            t0 = _time.time()
+            r = send(pid)
+            r["_rpc_ms"] = round((_time.time() - t0) * 1e3, 3)
+            return pid, r
+
         futures = [
-            self._pool.submit(send, p.id) for p in space.partitions
+            self._pool.submit(timed, p.id) for p in space.partitions
         ]
-        partials = [f.result() for f in futures]
+        results = [f.result() for f in futures]
+        partials = [r for _, r in results]
         merged = self._merge_search(partials, k)
-        return {"documents": merged}
+        out = {"documents": merged}
+        if body.get("trace"):
+            # per-partition timing breakdown (reference: trace:true
+            # response params, client/client.go:521-565)
+            out["params"] = {
+                str(pid): {"rpc_ms": r["_rpc_ms"], **r.get("timing", {})}
+                for pid, r in results
+            }
+        return out
 
     def _merge_search(
         self, partials: list[dict], k: int

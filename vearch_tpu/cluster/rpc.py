@@ -11,11 +11,14 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import traceback
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
+
+from vearch_tpu.cluster.metrics import Registry
 
 
 class RpcError(Exception):
@@ -35,6 +38,15 @@ class JsonRpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._routes: list[tuple[str, str, Callable]] = []
+        self.metrics = Registry()
+        self._m_requests = self.metrics.counter(
+            "vearch_request_total", "RPC requests",
+            ("method", "path", "code"),
+        )
+        self._m_latency = self.metrics.histogram(
+            "vearch_request_duration_seconds", "RPC latency",
+            ("method", "path"),
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -44,24 +56,45 @@ class JsonRpcServer:
                 pass
 
             def _serve(self, method: str):
+                if method == "GET" and self.path.split("?")[0] == "/metrics":
+                    data = outer.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                t0 = time.time()
+                code = 0
+                prefix = self.path.split("?")[0]
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     raw = self.rfile.read(length) if length else b""
                     body = json.loads(raw) if raw else None
-                    handler, parts = outer._match(method, self.path)
+                    match = outer._match(method, self.path)
+                    handler, parts = match
+                    if handler is not None:
+                        prefix = outer._matched_prefix(method, self.path)
                     if handler is None:
+                        code = 404
                         self._reply(404, {"code": 404, "msg": f"no route {method} {self.path}"})
                         return
                     result = handler(body, parts)
                     self._reply(200, {"code": 0, "data": result})
                 except RpcError as e:
+                    code = e.code
                     self._reply(200, {"code": e.code, "msg": e.msg})
                 except Exception as e:  # panic recovery
+                    code = 500
                     self._reply(
                         500,
                         {"code": 500, "msg": f"{type(e).__name__}: {e}",
                          "trace": traceback.format_exc(limit=8)},
                     )
+                finally:
+                    outer._m_requests.inc(method, prefix, str(code))
+                    outer._m_latency.observe(time.time() - t0, method, prefix)
 
             def _reply(self, status: int, obj: dict):
                 data = json.dumps(obj).encode()
@@ -92,6 +125,20 @@ class JsonRpcServer:
         """Register handler(body, parts) where parts = path segments after
         the prefix."""
         self._routes.append((method, prefix.rstrip("/"), handler))
+
+    def _matched_prefix(self, method: str, path: str) -> str:
+        """Longest matching route prefix (metric label — bounded
+        cardinality, unlike raw paths)."""
+        path = path.split("?")[0].rstrip("/")
+        best = ""
+        for m, prefix, _ in self._routes:
+            if m != method:
+                continue
+            if (path == prefix or path.startswith(prefix + "/")) and len(
+                prefix
+            ) > len(best):
+                best = prefix
+        return best or path
 
     def _match(self, method: str, path: str):
         path = path.split("?")[0].rstrip("/")

@@ -54,6 +54,10 @@ class SearchRequest:
     brute_force: bool = False  # force exact scan even when indexed
     field_weights: dict[str, float] = field(default_factory=dict)
     index_params: dict[str, Any] = field(default_factory=dict)  # nprobe etc.
+    # when not None, the engine records per-phase wall times into it
+    # (reference: per-request trace:true timing breakdown,
+    # client/client.go:521-565 + PerfTool, index_model.h:24)
+    trace: dict[str, float] | None = None
 
 
 class Engine:
@@ -209,6 +213,50 @@ class Engine:
         if t is not None:
             t.join(timeout)
 
+    def start_refresh_loop(self) -> None:
+        """Background realtime pump: absorb new rows into every trained
+        index at refresh_interval cadence so searches never pay the
+        absorb cost inline (reference: engine.cc:1106-1158 Indexing loop
+        sleeping refresh_interval_ between AddRTVecsToIndex passes)."""
+        if getattr(self, "_refresh_thread", None) is not None:
+            return
+        self._closed = threading.Event()
+
+        def loop():
+            while not self._closed.wait(
+                max(self.schema.refresh_interval_ms, 50) / 1e3
+            ):
+                for name, index in self.indexes.items():
+                    if index.trained:
+                        try:
+                            index.absorb(self.vector_stores[name].count)
+                        except Exception as e:
+                            self.last_build_error = e
+
+        self._refresh_thread = threading.Thread(target=loop, daemon=True)
+        self._refresh_thread.start()
+
+    def close(self) -> None:
+        if getattr(self, "_closed", None) is not None:
+            self._closed.set()
+
+    def apply_config(self, cfg: dict[str, Any]) -> dict[str, Any]:
+        """Runtime-mutable engine config (reference: master /config API ->
+        etcd -> PS watch, cluster_api.go:294-307; engine cache / limits).
+        Supported: refresh_interval_ms, training_threshold, plus default
+        index params merged per vector field."""
+        if "refresh_interval_ms" in cfg:
+            self.schema.refresh_interval_ms = int(cfg["refresh_interval_ms"])
+        if "training_threshold" in cfg:
+            self.schema.training_threshold = int(cfg["training_threshold"])
+        for name, params in (cfg.get("index_params") or {}).items():
+            if name in self.indexes:
+                self.indexes[name].params.params.update(params)
+        return {
+            "refresh_interval_ms": self.schema.refresh_interval_ms,
+            "training_threshold": self.schema.training_threshold,
+        }
+
     def build_index(self, field_name: str | None = None) -> None:
         """Train + absorb all current rows (reference: engine.cc:966
         BuildIndex -> Indexing thread; here synchronous — the cluster
@@ -274,6 +322,9 @@ class Engine:
             # device-resident so the hot path skips a [n]-bool H2D upload
             valid = self._device_alive_mask(n)
 
+        import time as _time
+
+        t_start = _time.time()
         metrics = {self.indexes[name].metric for name in req.vectors}
         if len(metrics) > 1:
             raise ValueError(
@@ -313,9 +364,17 @@ class Engine:
                 )
                 scores, ids = flat.search(queries, fetch_k, valid)
             per_field[name] = (scores, ids)
+            if req.trace is not None:
+                req.trace[f"search_{name}_ms"] = round(
+                    (_time.time() - t_start) * 1e3, 3
+                )
 
         merged = self._merge_fields(per_field, queries_by_field, req)
-        return self._shape_results(merged, req)
+        results = self._shape_results(merged, req)
+        if req.trace is not None:
+            req.trace["total_ms"] = round((_time.time() - t_start) * 1e3, 3)
+            req.trace["doc_count"] = self.doc_count
+        return results
 
     def _exact_score(
         self, name: str, query: np.ndarray, docids: list[int]
