@@ -1,0 +1,52 @@
+"""Native module tests: C++ extension vs pure-python oracles."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu import native
+from vearch_tpu.cluster.hashing import key_slot
+
+
+def test_native_builds():
+    assert native.available(), "g++ extension failed to build"
+
+
+def test_murmur3_batch_matches_python():
+    keys = ["", "hello", "doc1", "x" * 33, "日本語", "a" * 7]
+    got = native.murmur3_batch(keys)
+    expect = np.asarray([key_slot(k) for k in keys], dtype=np.uint32)
+    np.testing.assert_array_equal(got, expect)
+    assert got[1] == 0x248BFA47  # spaolacci/murmur3 vector for "hello"
+
+
+def test_merge_topk_matches_numpy(rng):
+    scores = rng.standard_normal((7, 40)).astype(np.float32)
+    ids = rng.integers(0, 10_000, (7, 40)).astype(np.int64)
+    s, i = native.merge_topk(scores, ids, 5)
+    order = np.argsort(-scores, axis=1)[:, :5]
+    np.testing.assert_allclose(s, np.take_along_axis(scores, order, axis=1))
+    np.testing.assert_array_equal(i, np.take_along_axis(ids, order, axis=1))
+    # ascending (L2 metric orientation)
+    s, i = native.merge_topk(scores, ids, 5, descending=False)
+    order = np.argsort(scores, axis=1)[:, :5]
+    np.testing.assert_allclose(s, np.take_along_axis(scores, order, axis=1))
+
+
+def test_fvecs_roundtrip(tmp_path, rng):
+    data = rng.standard_normal((100, 16)).astype(np.float32)
+    path = tmp_path / "t.fvecs"
+    with open(path, "wb") as f:
+        for row in data:
+            np.int32(16).tofile(f)
+            row.tofile(f)
+    got = native.read_fvecs(str(path))
+    np.testing.assert_array_equal(got, data)
+    got = native.read_fvecs(str(path), 10)
+    np.testing.assert_array_equal(got, data[:10])
+
+
+def test_fvecs_bad_file(tmp_path):
+    path = tmp_path / "bad.fvecs"
+    path.write_bytes(b"\xff\xff\xff\xff1234")
+    with pytest.raises(Exception):
+        native.read_fvecs(str(path))

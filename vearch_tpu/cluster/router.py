@@ -19,7 +19,6 @@ from typing import Any
 
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Server, Space
-from vearch_tpu.cluster.hashing import key_slot, partition_for_slot
 from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
 
 SPACE_CACHE_TTL = 3.0
@@ -135,20 +134,31 @@ class RouterServer:
 
     # -- document routes -----------------------------------------------------
 
+    def _partition_of_keys(self, space: Space, keys: list[str]) -> list[int]:
+        """Vectorised murmur3(_id) -> slot -> partition id (reference:
+        client.go:239 PartitionDocs). Hashing runs in the native module
+        (numpy fallback); the slot binary search is one searchsorted."""
+        import numpy as np
+
+        from vearch_tpu import native
+
+        slots = native.murmur3_batch(keys)
+        starts = np.asarray(space.slot_starts(), dtype=np.uint64)
+        idx = np.searchsorted(starts, slots.astype(np.uint64), side="right") - 1
+        return [space.partitions[int(i)].id for i in idx]
+
     def _route_docs(
         self, space: Space, docs: list[dict]
     ) -> dict[int, list[dict]]:
-        """murmur3(_id) -> slot -> partition (reference: client.go:239
-        PartitionDocs)."""
         import uuid
 
-        starts = space.slot_starts()
+        docs = [
+            doc if "_id" in doc else {**doc, "_id": uuid.uuid4().hex}
+            for doc in docs
+        ]
+        pids = self._partition_of_keys(space, [str(d["_id"]) for d in docs])
         by_partition: dict[int, list[dict]] = {}
-        for doc in docs:
-            if "_id" not in doc:
-                doc = {**doc, "_id": uuid.uuid4().hex}
-            idx = partition_for_slot(starts, key_slot(str(doc["_id"])))
-            pid = space.partitions[idx].id
+        for doc, pid in zip(docs, pids):
             by_partition.setdefault(pid, []).append(doc)
         return by_partition
 
@@ -287,12 +297,10 @@ class RouterServer:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
         if body.get("document_ids"):
-            starts = space.slot_starts()
+            keys_in = [str(k) for k in body["document_ids"]]
             by_partition: dict[int, list[str]] = {}
-            for key in body["document_ids"]:
-                idx = partition_for_slot(starts, key_slot(str(key)))
-                pid = space.partitions[idx].id
-                by_partition.setdefault(pid, []).append(str(key))
+            for key, pid in zip(keys_in, self._partition_of_keys(space, keys_in)):
+                by_partition.setdefault(pid, []).append(key)
 
             def send(pid: int, keys: list[str]):
                 return self._call_partition(
@@ -329,12 +337,10 @@ class RouterServer:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
         if body.get("document_ids"):
-            starts = space.slot_starts()
+            keys_in = [str(k) for k in body["document_ids"]]
             by_partition: dict[int, list[str]] = {}
-            for key in body["document_ids"]:
-                idx = partition_for_slot(starts, key_slot(str(key)))
-                pid = space.partitions[idx].id
-                by_partition.setdefault(pid, []).append(str(key))
+            for key, pid in zip(keys_in, self._partition_of_keys(space, keys_in)):
+                by_partition.setdefault(pid, []).append(key)
 
             def send(pid: int, keys: list[str]):
                 return self._call_partition(skey, pid, "/ps/doc/delete",
