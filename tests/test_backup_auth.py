@@ -1,0 +1,127 @@
+"""Backup/restore + user/role auth tests (reference:
+test_cluster_backup.py S3 backup/restore E2E; test_module_user/role)."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.master import MasterServer
+from vearch_tpu.cluster.ps import PSServer
+from vearch_tpu.cluster.router import RouterServer
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def test_backup_create_list_restore(tmp_path, rng):
+    store_root = str(tmp_path / "objectstore")
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((60, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(60)])
+
+        out = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                       {"command": "create", "store_root": store_root})
+        assert out["version"] == 1
+
+        # destroy data, then restore
+        cl.delete("db", "s", document_ids=[f"d{i}" for i in range(60)])
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
+                         limit=1)
+        assert hits[0] == []
+
+        versions = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                            {"command": "list", "store_root": store_root})
+        assert versions["versions"] == [1]
+
+        out = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                       {"command": "restore", "store_root": store_root,
+                        "version": 1})
+        assert sum(p["doc_count"] for p in out["partitions"]) == 60
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d3"
+
+
+def test_backup_missing_version(tmp_path, rng):
+    store_root = str(tmp_path / "obj2")
+    with StandaloneCluster(data_dir=str(tmp_path / "c2"), n_ps=1) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        with pytest.raises(Exception, match="not found"):
+            rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                     {"command": "restore", "store_root": store_root,
+                      "version": 9})
+
+
+@pytest.fixture
+def auth_cluster(tmp_path):
+    master = MasterServer(auth=True, root_password="rootpw")
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=master.addr)
+    ps.start()
+    router = RouterServer(master_addr=master.addr, auth=True,
+                          master_auth=("root", "rootpw"))
+    router.start()
+    yield master, ps, router
+    router.stop()
+    ps.stop()
+    master.stop()
+
+
+def test_auth_enforced(auth_cluster, rng):
+    master, ps, router = auth_cluster
+    root = ("root", "rootpw")
+
+    # unauthenticated master admin call is rejected
+    with pytest.raises(rpc.RpcError, match="Basic auth"):
+        rpc.call(master.addr, "POST", "/dbs/db1")
+    # root works
+    rpc.call(master.addr, "POST", "/dbs/db1", auth=root)
+    rpc.call(master.addr, "POST", "/dbs/db1/spaces", {
+        "name": "s", "partition_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    }, auth=root)
+
+    # router requires auth too
+    body = {"db_name": "db1", "space_name": "s",
+            "documents": [{"_id": "a", "v": [0.0] * D}]}
+    with pytest.raises(rpc.RpcError, match="Basic auth"):
+        rpc.call(router.addr, "POST", "/document/upsert", body)
+    rpc.call(router.addr, "POST", "/document/upsert", body, auth=root)
+
+    # read-only user: can read via router, cannot write master admin
+    rpc.call(master.addr, "POST", "/users",
+             {"name": "bob", "password": "pw", "role": "read"}, auth=root)
+    with pytest.raises(rpc.RpcError, match="read-only"):
+        rpc.call(master.addr, "POST", "/dbs/db2", auth=("bob", "pw"))
+    out = rpc.call(master.addr, "GET", "/dbs", auth=("bob", "pw"))
+    assert [d["name"] for d in out["dbs"]] == ["db1"]
+
+    # wrong password
+    with pytest.raises(rpc.RpcError, match="bad credentials"):
+        rpc.call(master.addr, "GET", "/dbs", auth=("bob", "nope"))
+
+    # user management round trip
+    users = rpc.call(master.addr, "GET", "/users", auth=root)["users"]
+    assert {u["name"] for u in users} == {"root", "bob"}
+    rpc.call(master.addr, "DELETE", "/users/bob", auth=root)
+    with pytest.raises(rpc.RpcError, match="bad credentials"):
+        rpc.call(master.addr, "GET", "/dbs", auth=("bob", "pw"))

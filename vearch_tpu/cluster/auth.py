@@ -1,0 +1,113 @@
+"""User/role auth: BasicAuth + privilege checks.
+
+Mirrors the reference's auth model (reference: entity/user.go User/Role/
+Privilege; root bootstrap master/server.go:160-181; BasicAuth middleware
+cluster_api.go:252 and router doc_http.go:179). Users carry a role; roles
+grant privileges per resource: "ResourceAll", "ResourceDocument",
+"ResourceSpace", ... with operations Read/Write/All.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import secrets
+
+from vearch_tpu.cluster.rpc import RpcError
+
+ROOT_NAME = "root"
+
+PRIVI_ALL = "All"
+PRIVI_READ = "Read"
+PRIVI_WRITE = "WriteOnly"
+
+RESOURCE_ALL = "ResourceAll"
+RESOURCE_DOCUMENT = "ResourceDocument"
+
+BUILTIN_ROLES = {
+    "root": {RESOURCE_ALL: PRIVI_ALL},
+    "read": {RESOURCE_ALL: PRIVI_READ},
+    "write": {RESOURCE_ALL: PRIVI_ALL},
+    "document": {RESOURCE_DOCUMENT: PRIVI_ALL},
+}
+
+
+def hash_password(password: str, salt: str | None = None) -> str:
+    salt = salt or secrets.token_hex(8)
+    digest = hashlib.sha256((salt + password).encode()).hexdigest()
+    return f"{salt}${digest}"
+
+
+def verify_password(password: str, stored: str) -> bool:
+    salt, _digest = stored.split("$", 1)
+    return secrets.compare_digest(hash_password(password, salt), stored)
+
+
+def parse_basic_auth(headers) -> tuple[str, str]:
+    """Extract (user, password) from an Authorization: Basic header."""
+    header = headers.get("Authorization", "")
+    if not header.startswith("Basic "):
+        raise RpcError(401, "missing Basic auth")
+    try:
+        raw = base64.b64decode(header[6:]).decode()
+        user, _, password = raw.partition(":")
+    except Exception as e:
+        raise RpcError(401, "malformed Basic auth") from e
+    return user, password
+
+
+class AuthService:
+    """Master-side user/role registry over the metastore."""
+
+    def __init__(self, store, root_password: str = "secret"):
+        self.store = store
+        if self.store.get(f"/user/{ROOT_NAME}") is None:
+            self.store.put(f"/user/{ROOT_NAME}", {
+                "name": ROOT_NAME,
+                "password": hash_password(root_password),
+                "role": "root",
+            })
+        for name, privileges in BUILTIN_ROLES.items():
+            if self.store.get(f"/role/{name}") is None:
+                self.store.put(f"/role/{name}",
+                               {"name": name, "privileges": privileges})
+
+    def create_user(self, name: str, password: str, role: str) -> dict:
+        if self.store.get(f"/user/{name}") is not None:
+            raise RpcError(409, f"user {name} exists")
+        if self.store.get(f"/role/{role}") is None:
+            raise RpcError(404, f"role {role} not found")
+        user = {"name": name, "password": hash_password(password),
+                "role": role}
+        self.store.put(f"/user/{name}", user)
+        return {"name": name, "role": role}
+
+    def delete_user(self, name: str) -> None:
+        if name == ROOT_NAME:
+            raise RpcError(400, "cannot delete root")
+        if not self.store.delete(f"/user/{name}"):
+            raise RpcError(404, f"user {name} not found")
+
+    def create_role(self, name: str, privileges: dict[str, str]) -> dict:
+        if self.store.get(f"/role/{name}") is not None:
+            raise RpcError(409, f"role {name} exists")
+        role = {"name": name, "privileges": privileges}
+        self.store.put(f"/role/{name}", role)
+        return role
+
+    def check(self, user: str, password: str) -> dict:
+        """Validate credentials; returns the user's role record."""
+        u = self.store.get(f"/user/{user}")
+        if u is None or not verify_password(password, u["password"]):
+            raise RpcError(401, "bad credentials")
+        role = self.store.get(f"/role/{u['role']}") or {"privileges": {}}
+        return {"name": user, "role": u["role"],
+                "privileges": role["privileges"]}
+
+    def authorize(self, privileges: dict[str, str], resource: str,
+                  write: bool) -> None:
+        grant = privileges.get(resource) or privileges.get(RESOURCE_ALL)
+        if grant is None:
+            raise RpcError(403, f"no privilege on {resource}")
+        if write and grant == PRIVI_READ:
+            raise RpcError(403, f"read-only privilege on {resource}")

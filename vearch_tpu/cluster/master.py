@@ -40,14 +40,40 @@ class MasterServer:
         port: int = 0,
         persist_path: str | None = None,
         heartbeat_ttl: float = HEARTBEAT_TTL,
+        auth: bool = False,
+        root_password: str = "secret",
     ):
+        from vearch_tpu.cluster.auth import AuthService, parse_basic_auth
+
         self.heartbeat_ttl = heartbeat_ttl
         self.store = MetaStore(persist_path)
         self._stop = threading.Event()
         self._leases: dict[int, int] = {}  # node_id -> lease id
+        self.auth_service = AuthService(self.store, root_password)
 
-        self.server = JsonRpcServer(host, port)
+        def authenticator(headers, method, path):
+            user, password = parse_basic_auth(headers)
+            record = self.auth_service.check(user, password)
+            self.auth_service.authorize(
+                record["privileges"], "ResourceAll",
+                write=method != "GET",
+            )
+
+        self.server = JsonRpcServer(
+            host,
+            port,
+            authenticator=authenticator if auth else None,
+            # PS registration and internal auth checks stay open
+            # (reference: /register is in the unauthenticated group)
+            auth_exempt=("/register", "/auth/check", "/"),
+        )
         s = self.server
+        s.route("POST", "/auth/check", self._h_auth_check)
+        s.route("POST", "/users", self._h_create_user)
+        s.route("GET", "/users", self._h_get_user)
+        s.route("DELETE", "/users", self._h_delete_user)
+        s.route("POST", "/roles", self._h_create_role)
+        s.route("GET", "/roles", self._h_get_role)
         s.route("GET", "/", self._h_cluster_info)
         s.route("POST", "/register", self._h_register)
         s.route("GET", "/servers", self._h_servers)
@@ -57,6 +83,7 @@ class MasterServer:
         s.route("GET", "/partitions", self._h_partitions)
         s.route("POST", "/config", self._h_set_config)
         s.route("GET", "/config", self._h_get_config)
+        s.route("POST", "/backup/dbs", self._h_backup)
 
     def start(self) -> None:
         self.server.start()
@@ -102,6 +129,46 @@ class MasterServer:
                         changed = True
             if changed:
                 self.store.put(key, sp)
+
+    # -- users / roles (reference: cluster_api.go user/role admin) -----------
+
+    def _h_auth_check(self, body: dict, _parts) -> dict:
+        return self.auth_service.check(body["name"], body["password"])
+
+    def _h_create_user(self, body: dict, _parts) -> dict:
+        return self.auth_service.create_user(
+            body["name"], body["password"], body.get("role", "read")
+        )
+
+    def _h_get_user(self, _body, parts) -> dict:
+        if parts:
+            u = self.store.get(f"/user/{parts[0]}")
+            if u is None:
+                raise RpcError(404, f"user {parts[0]} not found")
+            return {"name": u["name"], "role": u["role"]}
+        return {"users": [
+            {"name": u["name"], "role": u["role"]}
+            for u in self.store.prefix("/user/").values()
+        ]}
+
+    def _h_delete_user(self, _body, parts) -> dict:
+        if not parts:
+            raise RpcError(404, "DELETE /users/{name}")
+        self.auth_service.delete_user(parts[0])
+        return {"name": parts[0]}
+
+    def _h_create_role(self, body: dict, _parts) -> dict:
+        return self.auth_service.create_role(
+            body["name"], body.get("privileges", {})
+        )
+
+    def _h_get_role(self, _body, parts) -> dict:
+        if parts:
+            r = self.store.get(f"/role/{parts[0]}")
+            if r is None:
+                raise RpcError(404, f"role {parts[0]} not found")
+            return r
+        return {"roles": list(self.store.prefix("/role/").values())}
 
     # -- servers -------------------------------------------------------------
 
@@ -221,6 +288,83 @@ class MasterServer:
         if len(parts) != 2:
             raise RpcError(404, "GET /config/{db}/{space}")
         return self.store.get(f"/config/{parts[0]}/{parts[1]}") or {}
+
+    # -- backup/restore (reference: services/backup_service.go — versioned
+    #    space backup to object storage, cross-cluster restore) --------------
+
+    def _h_backup(self, body: dict, parts) -> dict:
+        if len(parts) != 3 or parts[1] != "spaces":
+            raise RpcError(404, "POST /backup/dbs/{db}/spaces/{space}")
+        db, _, name = parts
+        sp = self.store.get(f"{PREFIX_SPACE}{db}/{name}")
+        if sp is None:
+            raise RpcError(404, f"space {db}/{name} not found")
+        space = Space.from_dict(sp)
+        command = body.get("command", "create")
+        store_root = body["store_root"]
+        servers = {s.node_id: s for s in self._alive_servers()}
+        base_prefix = f"backup/{db}/{name}"
+
+        import json as _json
+        import os as _os
+
+        if command == "create":
+            version = self.store.next_id(f"/seq/backup/{db}/{name}")
+            prefix = f"{base_prefix}/v{version}"
+            # space metadata rides with the backup for cross-cluster restore
+            meta_dir = _os.path.join(store_root, prefix)
+            _os.makedirs(meta_dir, exist_ok=True)
+            with open(_os.path.join(meta_dir, "space.json"), "w") as f:
+                _json.dump(space.to_dict(), f)
+            results = []
+            for i, part in enumerate(sorted(space.partitions,
+                                            key=lambda p: p.slot)):
+                srv = servers.get(part.leader)
+                if srv is None:
+                    raise RpcError(503, f"leader of partition {part.id} down")
+                results.append(rpc.call(srv.rpc_addr, "POST", "/ps/backup", {
+                    "partition_id": part.id,
+                    "store_root": store_root,
+                    "key_prefix": f"{prefix}/shard_{i}",
+                }))
+            return {"version": version, "partitions": results}
+
+        if command == "list":
+            root = _os.path.join(store_root, base_prefix)
+            versions = sorted(
+                int(d[1:]) for d in _os.listdir(root)
+                if d.startswith("v")
+            ) if _os.path.isdir(root) else []
+            return {"versions": versions}
+
+        if command == "restore":
+            version = int(body["version"])
+            prefix = f"{base_prefix}/v{version}"
+            meta_path = _os.path.join(store_root, prefix, "space.json")
+            if not _os.path.isfile(meta_path):
+                raise RpcError(404, f"backup v{version} not found")
+            with open(meta_path) as f:
+                bmeta = _json.load(f)
+            if len(bmeta["partitions"]) != len(space.partitions):
+                raise RpcError(
+                    400,
+                    f"backup has {len(bmeta['partitions'])} shards but "
+                    f"space has {len(space.partitions)} partitions",
+                )
+            results = []
+            for i, part in enumerate(sorted(space.partitions,
+                                            key=lambda p: p.slot)):
+                srv = servers.get(part.leader)
+                if srv is None:
+                    raise RpcError(503, f"leader of partition {part.id} down")
+                results.append(rpc.call(srv.rpc_addr, "POST", "/ps/restore", {
+                    "partition_id": part.id,
+                    "store_root": store_root,
+                    "key_prefix": f"{prefix}/shard_{i}",
+                }))
+            return {"version": version, "partitions": results}
+
+        raise RpcError(400, f"unknown backup command {command!r}")
 
     # -- space create (reference: services/space_service.go:59) --------------
 

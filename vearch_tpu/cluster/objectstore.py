@@ -1,0 +1,74 @@
+"""Pluggable object store for backup/restore.
+
+The reference backs up shards to S3/MinIO (reference:
+ps/backup/ps_backup_service.go:14,67 minio client; versioned layout with
+ref-counted files). The interface here is S3-shaped (put/get/list by key);
+`LocalObjectStore` is the in-tree backend (shared filesystem / NFS), and
+an S3 backend can implement the same three methods against any client
+without touching the backup service (this image is zero-egress, so no S3
+SDK is vendored — see docs/PARITY.md).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class ObjectStore:
+    def put_file(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def get_file(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+
+class LocalObjectStore(ObjectStore):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        path = os.path.normpath(os.path.join(self.root, key.lstrip("/")))
+        if not path.startswith(os.path.abspath(self.root)) and not path.startswith(self.root):
+            raise ValueError(f"key escapes store root: {key}")
+        return path
+
+    def put_file(self, key: str, local_path: str) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copyfile(local_path, dst)
+
+    def get_file(self, key: str, local_path: str) -> None:
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        shutil.copyfile(self._path(key), local_path)
+
+    def list(self, prefix: str) -> list[str]:
+        base = self._path(prefix)
+        out = []
+        for dirpath, _dirs, files in os.walk(base):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                out.append(os.path.relpath(full, self.root))
+        return sorted(out)
+
+    def put_tree(self, key_prefix: str, local_dir: str) -> int:
+        n = 0
+        for dirpath, _dirs, files in os.walk(local_dir):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                rel = os.path.relpath(full, local_dir)
+                self.put_file(f"{key_prefix}/{rel}", full)
+                n += 1
+        return n
+
+    def get_tree(self, key_prefix: str, local_dir: str) -> int:
+        n = 0
+        for key in self.list(key_prefix):
+            rel = os.path.relpath(key, key_prefix)
+            self.get_file(key, os.path.join(local_dir, rel))
+            n += 1
+        return n

@@ -54,6 +54,8 @@ class PSServer:
         s.route("POST", "/ps/index/rebuild", self._h_rebuild)
         s.route("POST", "/ps/flush", self._h_flush)
         s.route("POST", "/ps/engine/config", self._h_engine_config)
+        s.route("POST", "/ps/backup", self._h_backup)
+        s.route("POST", "/ps/restore", self._h_restore)
         s.route("GET", "/ps/stats", self._h_stats)
 
     # -- lifecycle -----------------------------------------------------------
@@ -281,6 +283,41 @@ class PSServer:
     def _h_engine_config(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])
         return eng.apply_config(body.get("config") or {})
+
+    # -- backup/restore (reference: ps/backup/ps_backup_service.go:77
+    #    PSShardManager — shard dump streamed to object storage) -------------
+
+    def _h_backup(self, body: dict, _parts) -> dict:
+        import tempfile
+
+        from vearch_tpu.cluster.objectstore import LocalObjectStore
+
+        pid = int(body["partition_id"])
+        eng = self._engine(pid)
+        store = LocalObjectStore(body["store_root"])
+        with tempfile.TemporaryDirectory() as tmp:
+            eng.dump(tmp)
+            n = store.put_tree(body["key_prefix"], tmp)
+        return {"partition_id": pid, "files": n}
+
+    def _h_restore(self, body: dict, _parts) -> dict:
+        import shutil
+
+        from vearch_tpu.cluster.objectstore import LocalObjectStore
+
+        pid = int(body["partition_id"])
+        eng = self._engine(pid)  # partition must exist (space created first)
+        store = LocalObjectStore(body["store_root"])
+        data_dir = os.path.join(self.data_dir, f"partition_{pid}")
+        shutil.rmtree(data_dir, ignore_errors=True)
+        n = store.get_tree(body["key_prefix"], data_dir)
+        eng.close()
+        restored = Engine.open(data_dir)
+        restored.start_refresh_loop()
+        with self._lock:
+            self.engines[pid] = restored
+        return {"partition_id": pid, "files": n,
+                "doc_count": restored.doc_count}
 
     def _h_stats(self, _body, _parts) -> dict:
         return {

@@ -26,15 +26,27 @@ SPACE_CACHE_TTL = 3.0
 
 class RouterServer:
     def __init__(
-        self, master_addr: str, host: str = "127.0.0.1", port: int = 0
+        self,
+        master_addr: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        auth: bool = False,
+        master_auth: tuple[str, str] | None = None,
     ):
         self.master_addr = master_addr
+        # service-account credentials for master calls when auth is on
+        self.master_auth = master_auth
         self._space_cache: dict[str, tuple[float, Space]] = {}
         self._server_cache: tuple[float, dict[int, Server]] = (0.0, {})
+        self._auth_cache: dict[tuple[str, str], float] = {}
         self._cache_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=32)
 
-        self.server = JsonRpcServer(host, port)
+        self.server = JsonRpcServer(
+            host, port,
+            authenticator=self._authenticate if auth else None,
+            auth_exempt=("/cluster/health",),
+        )
         s = self.server
         s.route("POST", "/document/upsert", self._h_upsert)
         s.route("POST", "/document/search", self._h_search)
@@ -70,7 +82,7 @@ class RouterServer:
             hit = self._space_cache.get(key)
             if hit and now - hit[0] < SPACE_CACHE_TTL:
                 return hit[1]
-        data = rpc.call(self.master_addr, "GET", f"/dbs/{db}/spaces/{name}")
+        data = self._master_call("GET", f"/dbs/{db}/spaces/{name}")
         space = Space.from_dict(data)
         with self._cache_lock:
             self._space_cache[key] = (now, space)
@@ -82,7 +94,7 @@ class RouterServer:
             ts, cache = self._server_cache
             if now - ts < SPACE_CACHE_TTL and cache:
                 return cache
-        data = rpc.call(self.master_addr, "GET", "/servers")
+        data = self._master_call("GET", "/servers")
         servers = {
             s["node_id"]: Server.from_dict(s) for s in data["servers"]
         }
@@ -122,15 +134,35 @@ class RouterServer:
             return rpc.call(self._partition_addr(space, pid), "POST", path,
                             {**body, "partition_id": pid})
 
+    def _authenticate(self, headers, method, path) -> None:
+        """BasicAuth via the master's /auth/check, positively cached 5s
+        (reference: router doc_http.go:179 BasicAuth middleware)."""
+        from vearch_tpu.cluster.auth import parse_basic_auth
+
+        user, password = parse_basic_auth(headers)
+        key = (user, password)
+        now = time.time()
+        with self._cache_lock:
+            if self._auth_cache.get(key, 0.0) > now:
+                return
+        rpc.call(self.master_addr, "POST", "/auth/check",
+                 {"name": user, "password": password})
+        with self._cache_lock:
+            self._auth_cache[key] = now + 5.0
+
+    def _master_call(self, method: str, path: str, body=None):
+        return rpc.call(self.master_addr, method, path, body,
+                        auth=self.master_auth)
+
     def _proxy_master(self, method: str, prefix: str):
         def h(body, parts):
             path = prefix + ("/" + "/".join(parts) if parts else "")
-            return rpc.call(self.master_addr, method, path, body)
+            return self._master_call(method, path, body)
 
         return h
 
     def _h_health(self, _body, _parts) -> dict:
-        return rpc.call(self.master_addr, "GET", "/")
+        return self._master_call("GET", "/")
 
     # -- document routes -----------------------------------------------------
 

@@ -36,8 +36,18 @@ class JsonRpcServer:
     recovery, pkg/server/rpc/handler/).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authenticator: Callable | None = None,
+        auth_exempt: tuple[str, ...] = (),
+    ):
         self._routes: list[tuple[str, str, Callable]] = []
+        # authenticator(headers, method, path) raises RpcError(401/403)
+        # (reference: BasicAuth middleware, cluster_api.go:252)
+        self.authenticator = authenticator
+        self.auth_exempt = ("/metrics",) + auth_exempt
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
             "vearch_request_total", "RPC requests",
@@ -69,6 +79,11 @@ class JsonRpcServer:
                 code = 0
                 prefix = self.path.split("?")[0]
                 try:
+                    if outer.authenticator is not None and not any(
+                        prefix == p or prefix.startswith(p + "/")
+                        for p in outer.auth_exempt
+                    ):
+                        outer.authenticator(self.headers, method, prefix)
                     length = int(self.headers.get("Content-Length") or 0)
                     raw = self.rfile.read(length) if length else b""
                     body = json.loads(raw) if raw else None
@@ -172,13 +187,19 @@ def call(
     path: str,
     body: Any = None,
     timeout: float = 120.0,
+    auth: tuple[str, str] | None = None,
 ) -> Any:
     """Client side: raises RpcError on non-zero code."""
+    import base64
+
     url = f"http://{addr}{path}"
     data = json.dumps(body).encode() if body is not None else None
+    headers = {"Content-Type": "application/json"}
+    if auth is not None:
+        token = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
+        headers["Authorization"] = f"Basic {token}"
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"},
+        url, data=data, method=method, headers=headers,
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
