@@ -1,0 +1,83 @@
+"""Alias resolution + multi-vector WeightedRanker through the REST surface
+(reference: test_module_alias.py; doc_query.go:202 WeightedRanker)."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = StandaloneCluster(data_dir=str(tmp_path_factory.mktemp("ar")), n_ps=1)
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 1,
+        "fields": [
+            {"name": "a", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT",
+                       "metric_type": "InnerProduct", "params": {}}},
+            {"name": "b", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT",
+                       "metric_type": "InnerProduct", "params": {}}},
+        ],
+    })
+    yield c, cl
+    c.stop()
+
+
+def test_alias_crud_and_search(cluster, rng):
+    c, cl = cluster
+    va = rng.standard_normal((20, D)).astype(np.float32)
+    vb = rng.standard_normal((20, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "a": va[i], "b": vb[i]}
+                          for i in range(20)])
+
+    rpc.call(c.router_addr, "POST", "/alias/myalias/dbs/db/spaces/s")
+    aliases = rpc.call(c.router_addr, "GET", "/alias")["aliases"]
+    assert aliases[0]["name"] == "myalias"
+
+    # search via the alias name as space_name
+    hits = cl.search("db", "myalias",
+                     [{"field": "a", "feature": va[4]}], limit=1)
+    assert hits[0][0]["_id"] == "d4"
+
+    rpc.call(c.router_addr, "DELETE", "/alias/myalias")
+    with pytest.raises(Exception, match="not found"):
+        rpc.call(c.router_addr, "GET", "/alias/myalias")
+
+    # alias to a missing space is rejected
+    with pytest.raises(Exception, match="not found"):
+        rpc.call(c.router_addr, "POST", "/alias/x/dbs/db/spaces/nope")
+
+
+def test_weighted_ranker_rest(cluster, rng):
+    c, cl = cluster
+    q = rng.standard_normal(D).astype(np.float32)
+    hits = cl.search(
+        "db", "s",
+        [{"field": "a", "feature": q}, {"field": "b", "feature": q}],
+        limit=20,
+        ranker={"type": "WeightedRanker",
+                "params": [{"field": "a", "weight": 0.2},
+                           {"field": "b", "weight": 0.8}]},
+    )
+    docs = cl.query("db", "s", document_ids=[h["_id"] for h in hits[0]],
+                    vector_value=True)
+    by_id = {d["_id"]: d for d in docs}
+    scores = {
+        h["_id"]: 0.2 * float(np.dot(by_id[h["_id"]]["a"], q))
+        + 0.8 * float(np.dot(by_id[h["_id"]]["b"], q))
+        for h in hits[0]
+    }
+    got = [h["_id"] for h in hits[0]]
+    expect = sorted(scores, key=lambda k: -scores[k])
+    assert got == expect
+    for h in hits[0]:
+        assert h["_score"] == pytest.approx(scores[h["_id"]], abs=1e-4)

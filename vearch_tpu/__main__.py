@@ -1,0 +1,90 @@
+"""Role launcher: `python -m vearch_tpu --role master|ps|router|standalone`.
+
+The reference ships one binary that runs any combination of roles by CLI
+tag (reference: cmd/vearch/startup.go:87,112-120). Same shape here; each
+role blocks until SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="vearch_tpu")
+    ap.add_argument("--role", default="standalone",
+                    choices=["master", "ps", "router", "standalone"])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--master-addr", default=None,
+                    help="host:port of the master (ps/router roles)")
+    ap.add_argument("--data-dir", default="./vearch_data")
+    ap.add_argument("--auth", action="store_true")
+    ap.add_argument("--root-password", default="secret")
+    ap.add_argument("--n-ps", type=int, default=1,
+                    help="partition servers in standalone mode")
+    args = ap.parse_args(argv)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+
+    if args.role == "standalone":
+        from vearch_tpu.cluster.standalone import StandaloneCluster
+
+        cluster = StandaloneCluster(data_dir=args.data_dir, n_ps=args.n_ps)
+        cluster.start()
+        print(f"router: http://{cluster.router_addr}  "
+              f"master: http://{cluster.master_addr}", flush=True)
+        stop.wait()
+        cluster.stop()
+        return 0
+
+    if args.role == "master":
+        from vearch_tpu.cluster.master import MasterServer
+
+        server = MasterServer(
+            host=args.host, port=args.port,
+            persist_path=f"{args.data_dir}/meta.json",
+            auth=args.auth, root_password=args.root_password,
+        )
+        server.start()
+        print(f"master: http://{server.addr}", flush=True)
+        stop.wait()
+        server.stop()
+        return 0
+
+    if args.master_addr is None:
+        print("--master-addr required for ps/router roles", file=sys.stderr)
+        return 2
+
+    if args.role == "ps":
+        from vearch_tpu.cluster.ps import PSServer
+
+        server = PSServer(data_dir=args.data_dir, host=args.host,
+                          port=args.port, master_addr=args.master_addr)
+        server.start()
+        print(f"ps node {server.node_id}: http://{server.addr}", flush=True)
+        stop.wait()
+        server.stop()
+        return 0
+
+    from vearch_tpu.cluster.router import RouterServer
+
+    server = RouterServer(
+        master_addr=args.master_addr, host=args.host, port=args.port,
+        auth=args.auth,
+        master_auth=("root", args.root_password) if args.auth else None,
+    )
+    server.start()
+    print(f"router: http://{server.addr}", flush=True)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,9 @@ class MasterServer:
         s.route("POST", "/config", self._h_set_config)
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)
+        s.route("POST", "/alias", self._h_create_alias)
+        s.route("GET", "/alias", self._h_get_alias)
+        s.route("DELETE", "/alias", self._h_delete_alias)
 
     def start(self) -> None:
         self.server.start()
@@ -288,6 +291,32 @@ class MasterServer:
         if len(parts) != 2:
             raise RpcError(404, "GET /config/{db}/{space}")
         return self.store.get(f"/config/{parts[0]}/{parts[1]}") or {}
+
+    # -- aliases (reference: master alias service + entity/Alias;
+    #    POST /alias/{alias}/dbs/{db}/spaces/{space}) ------------------------
+
+    def _h_create_alias(self, _body, parts) -> dict:
+        if len(parts) != 5 or parts[1] != "dbs" or parts[3] != "spaces":
+            raise RpcError(404, "POST /alias/{alias}/dbs/{db}/spaces/{space}")
+        alias, _, db, _, space = parts
+        if self.store.get(f"{PREFIX_SPACE}{db}/{space}") is None:
+            raise RpcError(404, f"space {db}/{space} not found")
+        self.store.put(f"/alias/{alias}", {"name": alias, "db_name": db,
+                                           "space_name": space})
+        return {"name": alias}
+
+    def _h_get_alias(self, _body, parts) -> dict:
+        if parts:
+            a = self.store.get(f"/alias/{parts[0]}")
+            if a is None:
+                raise RpcError(404, f"alias {parts[0]} not found")
+            return a
+        return {"aliases": list(self.store.prefix("/alias/").values())}
+
+    def _h_delete_alias(self, _body, parts) -> dict:
+        if not parts or not self.store.delete(f"/alias/{parts[0]}"):
+            raise RpcError(404, "alias not found")
+        return {"name": parts[0]}
 
     # -- backup/restore (reference: services/backup_service.go — versioned
     #    space backup to object storage, cross-cluster restore) --------------

@@ -27,9 +27,11 @@ class MetaStore:
         self._leases: dict[int, tuple[float, list[str]]] = {}  # id -> (expiry, keys)
         self._next_lease = 1
         self._persist_path = persist_path
-        if persist_path and os.path.exists(persist_path):
-            with open(persist_path) as f:
-                self._kv = json.load(f)
+        if persist_path:
+            os.makedirs(os.path.dirname(persist_path) or ".", exist_ok=True)
+            if os.path.exists(persist_path):
+                with open(persist_path) as f:
+                    self._kv = json.load(f)
 
     # -- KV ------------------------------------------------------------------
 

@@ -30,6 +30,7 @@ class PSServer:
         port: int = 0,
         master_addr: str | None = None,
         heartbeat_interval: float = 2.0,
+        max_concurrent_searches: int = 256,
     ):
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -40,6 +41,9 @@ class PSServer:
         self.node_id: int | None = None
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
+        # concurrency gate (reference: RequestConcurrentController,
+        # search/engine.h:197; rpcx request concurrency, ps/server.go:89)
+        self._search_gate = threading.BoundedSemaphore(max_concurrent_searches)
 
         self.server = JsonRpcServer(host, port)
         s = self.server
@@ -223,6 +227,14 @@ class PSServer:
             name: np.asarray(v, dtype=np.float32)
             for name, v in body["vectors"].items()
         }
+        if not self._search_gate.acquire(timeout=30.0):
+            raise RpcError(429, "partition server search queue full")
+        try:
+            return self._do_search(eng, body, vectors)
+        finally:
+            self._search_gate.release()
+
+    def _do_search(self, eng, body, vectors) -> dict:
         trace = {} if body.get("trace") else None
         req = SearchRequest(
             vectors=vectors,

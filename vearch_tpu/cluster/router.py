@@ -58,6 +58,8 @@ class RouterServer:
         # master proxy (reference: doc_http.go:189-251 master-proxy routes)
         for method in ("GET", "POST", "PUT", "DELETE"):
             s.route(method, "/dbs", self._proxy_master(method, "/dbs"))
+        for method in ("GET", "POST", "DELETE"):
+            s.route(method, "/alias", self._proxy_master(method, "/alias"))
         s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
         s.route("GET", "/cluster/health", self._h_health)
 
@@ -82,7 +84,17 @@ class RouterServer:
             hit = self._space_cache.get(key)
             if hit and now - hit[0] < SPACE_CACHE_TTL:
                 return hit[1]
-        data = self._master_call("GET", f"/dbs/{db}/spaces/{name}")
+        try:
+            data = self._master_call("GET", f"/dbs/{db}/spaces/{name}")
+        except RpcError as e:
+            if e.code != 404:
+                raise
+            # alias resolution (reference: alias -> db/space indirection)
+            alias = self._master_call("GET", f"/alias/{name}")
+            data = self._master_call(
+                "GET",
+                f"/dbs/{alias['db_name']}/spaces/{alias['space_name']}",
+            )
         space = Space.from_dict(data)
         with self._cache_lock:
             self._space_cache[key] = (now, space)
