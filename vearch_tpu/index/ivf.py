@@ -390,18 +390,42 @@ class IVFPQIndex(_IVFBase):
             nprobe = self._nprobe(params)
             r = min(self._rerank_depth(k, params), self._cap * nprobe, 2048)
             valid = self._valid_device(valid_mask, self.store.count)
-            cand_s, cand_i = ivf_ops.ivfpq_candidates(
-                jnp.asarray(q),
-                self.centroids,
-                self._bucket_resid8,
-                self._bucket_scale,
-                self._bucket_vsq,
-                self._bucket_ids,
-                valid,
-                nprobe,
-                max(r, k),
-                metric,
+            kernel = (params or {}).get(
+                "probe_kernel", self.params.get("probe_kernel", "pallas")
             )
+            if kernel == "pallas":
+                from vearch_tpu.ops.ivf import _coarse_probes
+                from vearch_tpu.ops.pallas_kernels import (
+                    ivfpq_probe_search_pallas,
+                )
+
+                qd = jnp.asarray(q)
+                probes = _coarse_probes(qd, self.centroids, nprobe)
+                cand_s, cand_i = ivfpq_probe_search_pallas(
+                    qd,
+                    self.centroids,
+                    self._bucket_resid8,
+                    self._bucket_scale,
+                    self._bucket_vsq,
+                    self._bucket_ids,
+                    valid,
+                    probes,
+                    max(r, k),
+                    metric is MetricType.L2,
+                )
+            else:
+                cand_s, cand_i = ivf_ops.ivfpq_candidates(
+                    jnp.asarray(q),
+                    self.centroids,
+                    self._bucket_resid8,
+                    self._bucket_scale,
+                    self._bucket_vsq,
+                    self._bucket_ids,
+                    valid,
+                    nprobe,
+                    max(r, k),
+                    metric,
+                )
         base, base_sqnorm, _ = self.store.device_buffer()
         scores, ids = ivf_ops.exact_rerank(
             jnp.asarray(q, dtype=base.dtype),
