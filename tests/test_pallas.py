@@ -39,11 +39,10 @@ def test_probe_dots_matches_einsum(rng):
 def test_pallas_probe_search_matches_scan_kernel(rng):
     cents, resid8, scale, ids, vsq, valid = _setup(rng)
     q = rng.standard_normal((4, 32)).astype(np.float32)
-    probes = _coarse_probes(jnp.asarray(q), jnp.asarray(cents), 4)
     s1, i1 = ivfpq_probe_search_pallas(
         jnp.asarray(q), jnp.asarray(cents), jnp.asarray(resid8),
         jnp.asarray(scale), jnp.asarray(vsq), jnp.asarray(ids),
-        jnp.asarray(valid), probes, 10)
+        jnp.asarray(valid), 4, 10)
     s2, i2 = ivfpq_candidates(
         jnp.asarray(q), jnp.asarray(cents), jnp.asarray(resid8),
         jnp.asarray(scale), jnp.asarray(vsq), jnp.asarray(ids),
@@ -62,6 +61,9 @@ def test_engine_probe_mode_uses_pallas(rng):
         index=IndexParams("IVFPQ", MetricType.L2,
                           {"ncentroids": 16, "nsubvector": 4,
                            "scan_mode": "probe", "nprobe": 16,
+                           # force the pallas path even off-TPU (interpret
+                           # mode) so the engine wiring is exercised here
+                           "probe_kernel": "pallas",
                            "training_threshold": 500}))])
     eng = Engine(schema)
     eng.upsert([{"_id": f"d{i}", "v": vecs[i]} for i in range(3000)])

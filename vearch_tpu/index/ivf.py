@@ -390,26 +390,28 @@ class IVFPQIndex(_IVFBase):
             nprobe = self._nprobe(params)
             r = min(self._rerank_depth(k, params), self._cap * nprobe, 2048)
             valid = self._valid_device(valid_mask, self.store.count)
+            # pallas only pays off compiled; off-TPU the interpret-mode
+            # kernel would be drastically slower than the XLA scan
+            default_kernel = (
+                "pallas" if jax.default_backend() == "tpu" else "xla"
+            )
             kernel = (params or {}).get(
-                "probe_kernel", self.params.get("probe_kernel", "pallas")
+                "probe_kernel", self.params.get("probe_kernel", default_kernel)
             )
             if kernel == "pallas":
-                from vearch_tpu.ops.ivf import _coarse_probes
                 from vearch_tpu.ops.pallas_kernels import (
                     ivfpq_probe_search_pallas,
                 )
 
-                qd = jnp.asarray(q)
-                probes = _coarse_probes(qd, self.centroids, nprobe)
                 cand_s, cand_i = ivfpq_probe_search_pallas(
-                    qd,
+                    jnp.asarray(q),
                     self.centroids,
                     self._bucket_resid8,
                     self._bucket_scale,
                     self._bucket_vsq,
                     self._bucket_ids,
                     valid,
-                    probes,
+                    nprobe,
                     max(r, k),
                     metric is MetricType.L2,
                 )

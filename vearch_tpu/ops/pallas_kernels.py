@@ -81,7 +81,10 @@ def ivf_probe_dots(
                 lambda i, j, probes_ref: (probes_ref[i, j], 0, 0),
             ),
         ],
-        # one output row per query, persistent across the inner j loop
+        # one output row per query, persistent across the inner j loop.
+        # A slimmer (1, 1, cap) per-step block does not compile: Mosaic
+        # requires the second-to-last block dim to divide 8 or equal the
+        # array dim, and this nprobe-row block is the smallest legal one.
         out_specs=pl.BlockSpec(
             (1, nprobe, cap), lambda i, j, probes_ref: (i, 0, 0)
         ),
@@ -94,7 +97,7 @@ def ivf_probe_dots(
     )(probes, qb, bucket_resid8)
 
 
-@functools.partial(jax.jit, static_argnames=("r", "l2"))
+@functools.partial(jax.jit, static_argnames=("nprobe", "r", "l2"))
 def ivfpq_probe_search_pallas(
     queries: jax.Array,        # [B, d] f32
     centroids: jax.Array,      # [nlist, d] f32
@@ -103,11 +106,15 @@ def ivfpq_probe_search_pallas(
     bucket_vsq: jax.Array,     # [nlist, cap] f32
     bucket_ids: jax.Array,     # [nlist, cap] i32
     valid: jax.Array,          # [n_pad] bool
-    probes: jax.Array,         # [B, nprobe] i32
+    nprobe: int,
     r: int,
     l2: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """Full probe-mode IVFPQ search on top of the pallas dots kernel.
+    """Full probe-mode IVFPQ search: coarse probe selection + pallas bucket
+    scoring + top-k, one jitted program.
+
+    The [B, nlist] query-centroid dot matrix is computed once and reused
+    for both probe selection and the q.cent_c score term.
 
     Score decomposition per probed cluster c (approx v = cent_c + s_c*r8):
         q.v = q.cent_c + s_c * (q.r8);  L2 = -(|q|^2 - 2 q.v + |v|^2)
@@ -115,13 +122,15 @@ def ivfpq_probe_search_pallas(
     from vearch_tpu.ops.distance import sqnorms
 
     b, d = queries.shape
-    nprobe = probes.shape[1]
-    dots8 = ivf_probe_dots(queries, probes, bucket_resid8)  # [B, np, cap]
     qc = jax.lax.dot_general(
         queries, centroids, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     )  # [B, nlist]
+    # coarse assignment is L2 geometry (see ops/ivf.py _coarse_probes)
+    coarse = 2.0 * qc - sqnorms(centroids)[None, :]
+    _, probes = jax.lax.top_k(coarse, nprobe)  # [B, nprobe]
+    dots8 = ivf_probe_dots(queries, probes, bucket_resid8)  # [B, np, cap]
     qc_p = jnp.take_along_axis(qc, probes, axis=1)  # [B, nprobe]
     scale_p = bucket_scale[probes]  # [B, nprobe]
     dots = qc_p[:, :, None] + scale_p[:, :, None] * dots8
