@@ -95,6 +95,27 @@ def test_replicated_write_and_failover(repl_cluster, rng):
     assert any(v["node_id"] == dead_node for v in fails.values())
 
 
+def test_read_load_balancing(repl_cluster, rng):
+    """Follower reads return the same results (reference: load_balance
+    leader/not-leader/random, client/ps.go:33-39)."""
+    master, ps_nodes, router = repl_cluster
+    cl = VearchClient(router.addr)
+    cl.create_database("lb")
+    cl.create_space("lb", {
+        "name": "s", "partition_num": 1, "replica_num": 3,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((20, D)).astype(np.float32)
+    cl.upsert("lb", "s", [{"_id": f"d{i}", "v": vecs[i]} for i in range(20)])
+    for lb in ("leader", "random", "not_leader"):
+        for _ in range(3):
+            hits = cl.search("lb", "s", [{"field": "v", "feature": vecs[4]}],
+                             limit=1, load_balance=lb)
+            assert hits[0][0]["_id"] == "d4", lb
+
+
 def test_delete_replicates(repl_cluster, rng):
     master, ps_nodes, router = repl_cluster
     cl = VearchClient(router.addr)

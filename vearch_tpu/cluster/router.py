@@ -114,11 +114,27 @@ class RouterServer:
             self._server_cache = (now, servers)
         return servers
 
-    def _partition_addr(self, space: Space, partition_id: int) -> str:
+    def _partition_addr(
+        self, space: Space, partition_id: int, load_balance: str = "leader"
+    ) -> str:
+        """Pick a replica for the RPC (reference: client/ps.go:33-39
+        clientType LEADER/NOTLEADER/RANDOM). Writes always go to the
+        leader; reads may spread across replicas (replication is
+        synchronous, so followers serve the same committed state)."""
+        import random
+
         servers = self._servers()
         part = next(p for p in space.partitions if p.id == partition_id)
-        node = part.leader if part.leader >= 0 else part.replicas[0]
-        srv = servers.get(node)
+        leader = part.leader if part.leader >= 0 else part.replicas[0]
+        candidates = [r for r in part.replicas if r in servers]
+        node = leader
+        if load_balance == "random" and candidates:
+            node = random.choice(candidates)
+        elif load_balance == "not_leader":
+            followers = [r for r in candidates if r != leader]
+            if followers:
+                node = random.choice(followers)
+        srv = servers.get(node) or servers.get(leader)
         if srv is None:
             raise RpcError(503, f"no server for partition {partition_id}")
         return srv.rpc_addr
@@ -129,15 +145,16 @@ class RouterServer:
             self._server_cache = (0.0, {})
 
     def _call_partition(self, space_key: tuple[str, str], pid: int,
-                        path: str, body: dict):
-        """RPC to a partition's leader with one failover retry: an
-        unreachable leader triggers a metadata refresh (the master may
-        have promoted a replica) and a second attempt (reference:
-        client.go:433-447 replica failover retry loop)."""
+                        path: str, body: dict, load_balance: str = "leader"):
+        """RPC to a partition replica with one failover retry: an
+        unreachable node triggers a metadata refresh (the master may
+        have promoted a replica) and a second attempt against the leader
+        (reference: client.go:433-447 replica failover retry loop)."""
         space = self._space(*space_key)
         try:
-            return rpc.call(self._partition_addr(space, pid), "POST", path,
-                            {**body, "partition_id": pid})
+            return rpc.call(
+                self._partition_addr(space, pid, load_balance), "POST", path,
+                {**body, "partition_id": pid})
         except RpcError as e:
             if e.code != -1:
                 raise
@@ -290,8 +307,10 @@ class RouterServer:
             } if isinstance(body.get("ranker"), dict) else {},
         }
 
+        lb = body.get("load_balance", "leader")
+
         def send(pid: int):
-            return self._call_partition(skey, pid, "/ps/doc/search", sub)
+            return self._call_partition(skey, pid, "/ps/doc/search", sub, lb)
 
         import time as _time
 
@@ -346,11 +365,13 @@ class RouterServer:
             for key, pid in zip(keys_in, self._partition_of_keys(space, keys_in)):
                 by_partition.setdefault(pid, []).append(key)
 
+            lb = body.get("load_balance", "leader")
+
             def send(pid: int, keys: list[str]):
                 return self._call_partition(
                     skey, pid, "/ps/doc/query",
                     {"document_ids": keys, "fields": body.get("fields"),
-                     "vector_value": body.get("vector_value", False)})
+                     "vector_value": body.get("vector_value", False)}, lb)
 
             futures = [
                 self._pool.submit(send, pid, keys)

@@ -73,6 +73,7 @@ class VearchClient:
         fields: list[str] | None = None,
         index_params: dict | None = None,
         ranker: dict | None = None,
+        load_balance: str = "leader",
     ) -> list[list[dict]]:
         vectors = [
             {**v, "feature": (
@@ -83,6 +84,7 @@ class VearchClient:
         body = {
             "db_name": db_name, "space_name": space_name,
             "vectors": vectors, "limit": limit,
+            "load_balance": load_balance,
         }
         if filters:
             body["filters"] = filters
