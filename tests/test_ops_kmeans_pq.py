@@ -35,7 +35,8 @@ def test_assign_clusters_matches_numpy(rng):
     c = rng.standard_normal((9, 12)).astype(np.float32)
     got = np.asarray(km.assign_clusters(jnp.asarray(x), jnp.asarray(c), chunk=64))
     ref = np.argmin(((x[:, None] - c[None]) ** 2).sum(-1), axis=1)
-    np.testing.assert_array_equal(got, ref)
+    # assignment dots run in bf16 (f32 accum): allow rare near-tie flips
+    assert (got != ref).mean() < 0.01
 
 
 def test_pq_roundtrip_reduces_error(rng):

@@ -56,10 +56,15 @@ def kmeans_partials(
     def body(carry, inp):
         sums, counts = carry
         xc, vc = inp
+        # bf16 operands, f32 accumulation: assignment only needs to rank
+        # centroids, and single-pass bf16 is ~6x faster than the HIGHEST
+        # multi-pass f32 emulation at training scale; centroid *updates*
+        # stay full f32 below
         dots = jax.lax.dot_general(
-            xc, centroids, (((1,), (1,)), ((), ())),
+            xc.astype(jnp.bfloat16),
+            centroids.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
         )  # [chunk, k]
         # rank by -(||x||^2 - 2x.c + ||c||^2); ||x||^2 constant per row
         assign = jnp.argmax(2.0 * dots - c_sq[None, :], axis=1)  # [chunk]
@@ -167,9 +172,10 @@ def assign_clusters(x: jax.Array, centroids: jax.Array, chunk: int = 16384) -> j
 
     def body(_, xc):
         dots = jax.lax.dot_general(
-            xc, centroids, (((1,), (1,)), ((), ())),
+            xc.astype(jnp.bfloat16),
+            centroids.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
         )
         return None, jnp.argmax(2.0 * dots - c_sq[None, :], axis=1)
 
