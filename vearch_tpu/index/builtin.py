@@ -5,3 +5,4 @@ import vearch_tpu.index.binary  # noqa: F401
 import vearch_tpu.index.flat  # noqa: F401
 import vearch_tpu.index.hnsw  # noqa: F401
 import vearch_tpu.index.ivf  # noqa: F401
+import vearch_tpu.index.sharded_flat  # noqa: F401

@@ -24,6 +24,8 @@ def register_index(name: str) -> Callable[[Type[VectorIndex]], Type[VectorIndex]
 
 def create_index(params: IndexParams, store: RawVectorStore) -> VectorIndex:
     name = params.index_type.upper()
+    if name == "FLAT" and params.get("sharded"):
+        name = "FLAT_SHARDED"  # multi-chip variant behind the same type
     if name not in _REGISTRY:
         # import built-ins lazily so registration is a side effect of use
         import vearch_tpu.index.builtin  # noqa: F401

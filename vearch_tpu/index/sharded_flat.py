@@ -1,0 +1,106 @@
+"""Multi-chip FLAT index: one partition spanning a local TPU slice.
+
+Where the reference scales only by adding partitions across machines
+(SURVEY §2.3), a TPU host owns several chips over ICI — an axis the
+reference never had. `FLAT` with `{"sharded": true}` row-shards the
+partition's vectors over a (data x query) mesh of all local devices and
+merges per-shard top-k with an `all_gather` on ICI
+(parallel/sharded.py). The cluster layer still shards across hosts.
+
+Realtime model: absorb re-places the whole host buffer on the mesh when
+rows arrived (placement is one H2D per device; fine at refresh-interval
+cadence — an incremental per-shard tail-append is a round-2 item). The
+deletion/filter mask is sharded per search, cached per bitmap version by
+the engine upstream.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from vearch_tpu.engine.raw_vector import RawVectorStore
+from vearch_tpu.engine.types import IndexParams, MetricType
+from vearch_tpu.index.base import VectorIndex
+from vearch_tpu.index.registry import register_index
+from vearch_tpu.parallel import mesh as mesh_lib
+from vearch_tpu.parallel.sharded import sharded_flat_search
+
+
+@register_index("FLAT_SHARDED")
+class ShardedFlatIndex(VectorIndex):
+    """Exact search over all local devices (index_type FLAT_SHARDED, or
+    FLAT with params {"sharded": true} via the registry alias in
+    index/flat.py)."""
+
+    needs_training = False
+
+    def __init__(self, params: IndexParams, store: RawVectorStore):
+        super().__init__(params, store)
+        n_dev = int(params.get("n_devices", 0)) or len(jax.devices())
+        query_axis = int(params.get("query_axis", 1))
+        self.mesh = mesh_lib.make_mesh(n_dev, query_axis=query_axis)
+        self._base = None
+        self._sqnorm = None
+        self._placed_rows = 0
+
+    def _maybe_normalize(self, x: np.ndarray) -> np.ndarray:
+        if self.metric is MetricType.COSINE:
+            n = np.maximum(np.linalg.norm(x, axis=-1, keepdims=True), 1e-15)
+            return (x / n).astype(np.float32)
+        return x
+
+    def _place(self) -> None:
+        from vearch_tpu.ops.distance import sqnorms
+
+        host = self._maybe_normalize(
+            self.store.host_view().astype(np.float32)
+        ).astype(self.store.store_dtype)
+        self._base, self._n = mesh_lib.shard_rows(self.mesh, host)
+        self._sqnorm = sqnorms(self._base)
+        self._placed_rows = self.store.count
+
+    def absorb(self, upto: int) -> None:
+        with self._absorb_lock:
+            self.indexed_count = max(self.indexed_count, upto)
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        valid_mask,
+        params: dict | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        if self._base is None or self._placed_rows < self.store.count:
+            self._place()
+        q = self._maybe_normalize(np.asarray(queries, np.float32))
+        metric = (
+            MetricType.INNER_PRODUCT
+            if self.metric is MetricType.COSINE
+            else self.metric
+        )
+        # sharded validity mask: alive rows up to the placed count
+        n_pad = self._base.shape[0]
+        v = np.zeros(n_pad, dtype=bool)
+        n = min(self._placed_rows, n_pad)
+        if valid_mask is not None:
+            vm = np.asarray(valid_mask)[:n]
+            v[: vm.shape[0]] = vm
+        else:
+            v[:n] = True
+        valid_dev, _ = mesh_lib.shard_rows(self.mesh, v)
+        qd, b = mesh_lib.shard_queries(
+            self.mesh, q.astype(self.store.store_dtype)
+        )
+        scores, ids = sharded_flat_search(
+            self.mesh, self._base, self._sqnorm, valid_dev, qd,
+            min(k, max(n, 1)), metric,
+        )
+        scores, ids = jax.device_get((scores, ids))
+        scores, ids = scores[:b], ids[:b]
+        if scores.shape[1] < k:
+            pad = k - scores.shape[1]
+            scores = np.pad(scores, ((0, 0), (0, pad)),
+                            constant_values=float("-inf"))
+            ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+        return scores[:, :k], ids[:, :k]
