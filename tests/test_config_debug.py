@@ -1,0 +1,61 @@
+"""Config loader + debug endpoints."""
+
+import urllib.request
+
+import pytest
+
+from vearch_tpu.cluster.config import Config
+from vearch_tpu.cluster.master import MasterServer
+
+
+def test_config_load_and_sections(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text("""
+[global]
+data = "/tmp/vd"
+auth = true
+root_password = "pw"
+
+[master]
+port = 8817
+heartbeat_ttl = 4.0
+
+[ps]
+port = 8081
+""")
+    cfg = Config.load(str(p))
+    assert cfg.data_dir == "/tmp/vd"
+    assert cfg.auth and cfg.root_password == "pw"
+    assert cfg.master["port"] == 8817
+    assert cfg.ps["port"] == 8081
+
+
+def test_config_validation(tmp_path):
+    p = tmp_path / "bad.toml"
+    p.write_text("[master]\nport = 99999\n")
+    with pytest.raises(ValueError, match="out of range"):
+        Config.load(str(p))
+    p.write_text("[master]\nheartbeat_ttl = -1\n")
+    with pytest.raises(ValueError, match="positive"):
+        Config.load(str(p))
+
+
+def test_main_conf_flag(tmp_path):
+    # the launcher parses --conf without starting (role validation path)
+    from vearch_tpu.__main__ import main
+
+    p = tmp_path / "c.toml"
+    p.write_text("[router]\nport = 9001\n")
+    # router role without master_addr exits 2 (after reading the conf)
+    assert main(["--role", "router", "--conf", str(p)]) == 2
+
+
+def test_debug_stacks_endpoint():
+    m = MasterServer()
+    m.start()
+    try:
+        with urllib.request.urlopen(f"http://{m.addr}/debug/stacks") as r:
+            text = r.read().decode()
+        assert "thread" in text and "_serve" in text
+    finally:
+        m.stop()

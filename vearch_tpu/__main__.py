@@ -17,6 +17,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="vearch_tpu")
     ap.add_argument("--role", default="standalone",
                     choices=["master", "ps", "router", "standalone"])
+    ap.add_argument("--conf", default=None,
+                    help="TOML config file (reference: -conf config.toml)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--master-addr", default=None,
@@ -27,6 +29,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--n-ps", type=int, default=1,
                     help="partition servers in standalone mode")
     args = ap.parse_args(argv)
+
+    if args.conf:
+        from vearch_tpu.cluster.config import Config
+
+        cfg = Config.load(args.conf)
+        section = getattr(cfg, args.role, {}) if args.role != "standalone" \
+            else {}
+        args.host = section.get("host", args.host)
+        args.port = int(section.get("port", args.port))
+        args.master_addr = section.get("master_addr", args.master_addr)
+        args.data_dir = cfg.data_dir if args.data_dir == "./vearch_data" \
+            else args.data_dir
+        args.auth = args.auth or cfg.auth
+        args.root_password = cfg.root_password
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())

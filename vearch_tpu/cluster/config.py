@@ -1,0 +1,75 @@
+"""TOML config loader (reference: config/config.toml parsed by
+internal/config/config.go — one file for all roles with [global],
+[masters], [router], [ps] sections + per-role Validate).
+
+Example:
+
+    [global]
+    name = "vearch-tpu"
+    data = "./vearch_data"
+    auth = false
+    root_password = "secret"
+
+    [master]
+    host = "127.0.0.1"
+    port = 8817
+    heartbeat_ttl = 8.0
+
+    [router]
+    port = 9001
+
+    [ps]
+    port = 8081
+    max_concurrent_searches = 256
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class Config:
+    global_: dict[str, Any] = field(default_factory=dict)
+    master: dict[str, Any] = field(default_factory=dict)
+    router: dict[str, Any] = field(default_factory=dict)
+    ps: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        cfg = cls(
+            global_=raw.get("global", {}),
+            master=raw.get("master", {}),
+            router=raw.get("router", {}),
+            ps=raw.get("ps", {}),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        """Per-role sanity checks (reference: per-role Validate,
+        cmd/vearch/startup.go:168)."""
+        for section, d in (("master", self.master), ("router", self.router),
+                           ("ps", self.ps)):
+            port = d.get("port")
+            if port is not None and not (0 <= int(port) < 65536):
+                raise ValueError(f"[{section}] port {port} out of range")
+        ttl = self.master.get("heartbeat_ttl")
+        if ttl is not None and float(ttl) <= 0:
+            raise ValueError("[master] heartbeat_ttl must be positive")
+
+    @property
+    def data_dir(self) -> str:
+        return self.global_.get("data", "./vearch_data")
+
+    @property
+    def auth(self) -> bool:
+        return bool(self.global_.get("auth", False))
+
+    @property
+    def root_password(self) -> str:
+        return str(self.global_.get("root_password", "secret"))

@@ -66,8 +66,29 @@ class JsonRpcServer:
                 pass
 
             def _serve(self, method: str):
-                if method == "GET" and self.path.split("?")[0] == "/metrics":
-                    data = outer.metrics.render().encode()
+                plain_path = self.path.split("?")[0]
+                if method == "GET" and plain_path in ("/metrics",
+                                                      "/debug/stacks"):
+                    if plain_path == "/metrics":
+                        data = outer.metrics.render().encode()
+                    else:
+                        # pprof-style live thread dump (reference:
+                        # debugutil/pprofui goroutine profiles)
+                        import sys
+
+                        names = {
+                            t.ident: t.name for t in threading.enumerate()
+                        }
+                        lines = []
+                        for tid, frame in sys._current_frames().items():
+                            lines.append(
+                                f"--- thread {tid} ({names.get(tid, '?')}) ---"
+                            )
+                            lines.extend(
+                                s.rstrip()
+                                for s in traceback.format_stack(frame)
+                            )
+                        data = "\n".join(lines).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
