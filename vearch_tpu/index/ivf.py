@@ -200,19 +200,23 @@ class IVFFlatIndex(_IVFBase):
         self._bucket_sqnorm: jax.Array | None = None
 
     def _publish(self) -> None:
-        ids = self._publish_ids()
-        cap = ids.shape[1]
-        d = self.store.dimension
-        host = self.store.host_view()
-        vecs = np.zeros((self.nlist, cap, d), dtype=np.float32)
-        for c, mm in enumerate(self._members):
-            if mm:
-                vecs[c, : len(mm)] = self._maybe_normalize(
-                    host[np.asarray(mm, dtype=np.int64)]
-                )
-        self._bucket_vecs = jnp.asarray(vecs, dtype=self.store.store_dtype)
-        self._bucket_sqnorm = sqnorms(self._bucket_vecs)
-        self._dirty = False
+        # under the absorb lock: a concurrent absorb would grow _members
+        # between capacity sizing and the fill loop (found by the
+        # concurrency stress test)
+        with self._absorb_lock:
+            ids = self._publish_ids()
+            cap = ids.shape[1]
+            d = self.store.dimension
+            host = self.store.host_view()
+            vecs = np.zeros((self.nlist, cap, d), dtype=np.float32)
+            for c, mm in enumerate(self._members):
+                if mm:
+                    vecs[c, : len(mm)] = self._maybe_normalize(
+                        host[np.asarray(mm, dtype=np.int64)]
+                    )
+            self._bucket_vecs = jnp.asarray(vecs, dtype=self.store.store_dtype)
+            self._bucket_sqnorm = sqnorms(self._bucket_vecs)
+            self._dirty = False
 
     def search(
         self,
@@ -329,6 +333,10 @@ class IVFPQIndex(_IVFBase):
         The decode+quantize runs once per publish (numpy, ~1s/M rows);
         searches then scan pure int8 matmuls (see ops/ivf.py design note).
         """
+        with self._absorb_lock:
+            self._publish_locked()
+
+    def _publish_locked(self) -> None:
         ids = self._publish_ids()
         cap = ids.shape[1]
         d = self.store.dimension
