@@ -71,7 +71,7 @@ class Engine:
         self.status = IndexStatus.UNINDEXED
         self._write_lock = threading.Lock()
         self._scalar_manager = None
-        if any(
+        if schema.composite_indexes or any(
             f.scalar_index.value != "NONE" for f in schema.scalar_fields()
         ):
             from vearch_tpu.scalar.manager import ScalarIndexManager

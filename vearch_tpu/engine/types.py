@@ -141,6 +141,8 @@ class TableSchema:
     fields: list[FieldSchema]
     training_threshold: int = 0
     refresh_interval_ms: int = 1000
+    # multi-column equality indexes (reference: composite_index.h)
+    composite_indexes: list[list[str]] = field(default_factory=list)
 
     def vector_fields(self) -> list[FieldSchema]:
         return [f for f in self.fields if f.is_vector()]
@@ -160,6 +162,7 @@ class TableSchema:
             "fields": [f.to_dict() for f in self.fields],
             "training_threshold": self.training_threshold,
             "refresh_interval_ms": self.refresh_interval_ms,
+            "composite_indexes": self.composite_indexes,
         }
 
     @classmethod
@@ -169,6 +172,7 @@ class TableSchema:
             fields=[FieldSchema.from_dict(f) for f in d["fields"]],
             training_threshold=d.get("training_threshold", 0),
             refresh_interval_ms=d.get("refresh_interval_ms", 1000),
+            composite_indexes=[list(c) for c in d.get("composite_indexes", [])],
         )
 
 

@@ -112,12 +112,32 @@ def evaluate_condition(cond: Condition, engine, n: int) -> np.ndarray:
 
 
 def evaluate_filter(flt, engine, n: int) -> np.ndarray:
-    """Evaluate a Filter (or its dict form) to an [n] bool mask."""
+    """Evaluate a Filter (or its dict form) to an [n] bool mask.
+
+    Planning: an AND filter whose equality conditions exactly cover a
+    declared composite index resolves those in one composite lookup
+    (reference: scalar_index_manager.h composite strategy); all other
+    conditions evaluate per-field and combine.
+    """
     if isinstance(flt, dict):
         flt = Filter.from_dict(flt)
     if not flt.conditions:
         return np.ones(n, dtype=bool)
-    masks = [evaluate_condition(c, engine, n) for c in flt.conditions]
+
+    conditions = list(flt.conditions)
+    masks: list[np.ndarray] = []
+    mgr = engine._scalar_manager
+    if flt.operator == "AND" and mgr is not None:
+        eq = [c for c in conditions if c.operator == "="]
+        ci = mgr.composite_for({c.field for c in eq}) if eq else None
+        if ci is not None:
+            by_field = {c.field: c.value for c in eq}
+            masks.append(ci.query_equalities(
+                tuple(by_field[f] for f in ci.fields), n
+            ))
+            conditions = [c for c in conditions if c not in eq]
+
+    masks.extend(evaluate_condition(c, engine, n) for c in conditions)
     out = masks[0].copy()
     for m in masks[1:]:
         if flt.operator == "AND":

@@ -93,6 +93,31 @@ class InvertedScalarIndex:
         return mask
 
 
+class CompositeScalarIndex:
+    """Multi-column index for conjunctive equality filters (reference:
+    table/composite_index.h:38 — multi-column RocksDB keys; the manager's
+    composite strategy, scalar_index_manager.h:27).
+
+    Keyed by the tuple of the member fields' values: an AND filter whose
+    equality conditions cover exactly the member fields resolves in one
+    dict lookup instead of intersecting per-field masks. Range/term
+    conditions fall back to the per-field path.
+    """
+
+    def __init__(self, fields: list[str]):
+        self.fields = list(fields)
+        self._index: dict[tuple, list[int]] = {}
+
+    def add(self, values: tuple, docid: int) -> None:
+        self._index.setdefault(tuple(values), []).append(docid)
+
+    def query_equalities(self, values: tuple, n: int) -> np.ndarray:
+        mask = np.zeros(n, dtype=bool)
+        ids = np.asarray(self._index.get(tuple(values), []), dtype=np.int64)
+        mask[ids[ids < n]] = True
+        return mask
+
+
 class BitmapScalarIndex:
     """Per-distinct-value packed bitmap — for low-cardinality fields
     (reference: table/bitmap_index.h:23 roaring bitmaps)."""

@@ -28,7 +28,8 @@ _NUMERIC = {
 
 
 class ScalarIndexManager:
-    def __init__(self, schema: TableSchema):
+    def __init__(self, schema: TableSchema,
+                 composite: list[list[str]] | None = None):
         self.schema = schema
         self._indexes: dict[str, Any] = {}
         for f in schema.scalar_fields():
@@ -39,15 +40,35 @@ class ScalarIndexManager:
                 )
             elif f.scalar_index is ScalarIndexType.BITMAP:
                 self._indexes[f.name] = BitmapScalarIndex()
+        from vearch_tpu.scalar.indexes import CompositeScalarIndex
+
+        self._composites: list[CompositeScalarIndex] = [
+            CompositeScalarIndex(fields)
+            for fields in (composite or getattr(schema, "composite_indexes",
+                                                None) or [])
+        ]
 
     def has_index(self, field: str) -> bool:
         return field in self._indexes
+
+    def composite_for(self, fields: set[str]):
+        """A composite index whose member set equals `fields`, if any
+        (the manager's filter planning step — reference:
+        scalar_index_manager.h FilterIndexPair)."""
+        for ci in self._composites:
+            if set(ci.fields) == fields:
+                return ci
+        return None
 
     def add_docs(self, docs: list[dict[str, Any]], base_docid: int) -> None:
         for name, index in self._indexes.items():
             for i, doc in enumerate(docs):
                 if name in doc:
                     index.add(doc[name], base_docid + i)
+        for ci in self._composites:
+            for i, doc in enumerate(docs):
+                if all(f in doc for f in ci.fields):
+                    ci.add(tuple(doc[f] for f in ci.fields), base_docid + i)
 
     def query(self, cond: Condition, n: int) -> np.ndarray:
         return self._indexes[cond.field].query(cond, n)
@@ -56,12 +77,18 @@ class ScalarIndexManager:
         """Re-derive indexes from the table after Engine.load (indexes are
         rebuildable state; the table is durable — reference: index
         rebuildable, raw data durable)."""
-        for name, index in self._indexes.items():
+        def column_rows(name):
             try:
-                col = table.column(name)
-                rows = list(col)
+                return list(table.column(name))
             except KeyError:
-                rows = table.string_column(name)
-            for docid, value in enumerate(rows):
+                return table.string_column(name)
+
+        for name, index in self._indexes.items():
+            for docid, value in enumerate(column_rows(name)):
                 if value is not None:
                     index.add(value, docid)
+        for ci in self._composites:
+            cols = {f: column_rows(f) for f in ci.fields}
+            count = min(len(v) for v in cols.values()) if cols else 0
+            for docid in range(count):
+                ci.add(tuple(cols[f][docid] for f in ci.fields), docid)
