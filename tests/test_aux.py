@@ -75,6 +75,52 @@ def test_runtime_config_roundtrip(cluster):
     assert eng.schema.refresh_interval_ms == 200
 
 
+def test_memory_limit_write_guard(tmp_path, rng):
+    """Writes are rejected past the resource limit; reads still serve
+    (reference: store_writer.go:82-95 ResourceExhausted)."""
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+    from vearch_tpu.sdk.client import VearchClient
+
+    master = MasterServer()
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ml"), master_addr=master.addr,
+                  memory_limit_mb=1)
+    ps.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("m")
+        cl.create_space("m", {
+            "name": "s", "partition_num": 1,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": 64,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((5000, 64)).astype(np.float32)
+        cl.upsert("m", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                             for i in range(2500)])
+        cl.upsert("m", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                             for i in range(2500, 5000)])
+        # > 1MB of f32 vectors now resident -> further writes rejected
+        with pytest.raises(Exception, match="resource_exhausted"):
+            cl.upsert("m", "s", [{"_id": "x", "v": vecs[0]}])
+        # reads still work
+        hits = cl.search("m", "s", [{"field": "v", "feature": vecs[5]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d5"
+        # raising the limit at runtime re-enables writes
+        rpc.call(master.addr, "POST", "/config/m/s",
+                 {"memory_limit_mb": 1000})
+        cl.upsert("m", "s", [{"_id": "x", "v": vecs[0]}])
+    finally:
+        router.stop()
+        ps.stop()
+        master.stop()
+
+
 def test_refresh_loop_absorbs_in_background(rng):
     from vearch_tpu.engine.engine import Engine
     from vearch_tpu.engine.types import (

@@ -31,6 +31,7 @@ class PSServer:
         master_addr: str | None = None,
         heartbeat_interval: float = 2.0,
         max_concurrent_searches: int = 256,
+        memory_limit_mb: int = 0,
     ):
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -44,6 +45,9 @@ class PSServer:
         # concurrency gate (reference: RequestConcurrentController,
         # search/engine.h:197; rpcx request concurrency, ps/server.go:89)
         self._search_gate = threading.BoundedSemaphore(max_concurrent_searches)
+        # 0 = unlimited (reference: resource-limit write guard,
+        # store_writer.go:82-95 -> partition flips read-only)
+        self.memory_limit_mb = memory_limit_mb
 
         self.server = JsonRpcServer(host, port)
         s = self.server
@@ -188,6 +192,17 @@ class PSServer:
     def _h_upsert(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])
         eng = self._engine(pid)
+        if self.memory_limit_mb:
+            used = sum(
+                e.memory_usage_bytes() for e in self.engines.values()
+            ) >> 20
+            if used >= self.memory_limit_mb:
+                raise RpcError(
+                    403,
+                    f"resource_exhausted: {used}MB >= "
+                    f"limit {self.memory_limit_mb}MB (writes rejected, "
+                    f"reads still served)",
+                )
         keys = eng.upsert(body["documents"])
         if not body.get("replicated"):
             self._replicate(pid, "/ps/doc/upsert",
@@ -293,8 +308,11 @@ class PSServer:
         return {"doc_count": eng.doc_count}
 
     def _h_engine_config(self, body: dict, _parts) -> dict:
+        cfg = body.get("config") or {}
+        if "memory_limit_mb" in cfg:
+            self.memory_limit_mb = int(cfg["memory_limit_mb"])
         eng = self._engine(body["partition_id"])
-        return eng.apply_config(body.get("config") or {})
+        return eng.apply_config(cfg)
 
     # -- backup/restore (reference: ps/backup/ps_backup_service.go:77
     #    PSShardManager — shard dump streamed to object storage) -------------
@@ -338,6 +356,7 @@ class PSServer:
                 str(pid): {
                     "doc_count": eng.doc_count,
                     "status": int(eng.status),
+                    "memory_bytes": eng.memory_usage_bytes(),
                 }
                 for pid, eng in self.engines.items()
             },

@@ -155,6 +155,24 @@ class Engine:
         """Alive docs (reference: engine status doc_num minus deletes)."""
         return self.table.doc_count - self.bitmap.deleted_count
 
+    def memory_usage_bytes(self) -> int:
+        """Host-side memory of the durable structures (raw vectors +
+        quantized mirrors + codes). Drives the resource-limit write guard
+        (reference: store_writer.go:82-95 resource check every 50k docs;
+        memory/memoryManager.cc accounting)."""
+        total = 0
+        for store in self.vector_stores.values():
+            total += store.host_view().nbytes  # used rows, not capacity
+        for index in self.indexes.values():
+            mirror = getattr(index, "_mirror", None)
+            if mirror is not None:
+                n = mirror.count
+                total += n * (mirror.dimension + 8)  # int8 row + scale + vsq
+            codes = getattr(index, "_codes", None)
+            if codes is not None:
+                total += codes.nbytes
+        return total
+
     def query(
         self,
         filters: Any = None,
