@@ -69,6 +69,48 @@ def test_backup_missing_version(tmp_path, rng):
                       "version": 9})
 
 
+def test_replication_works_with_auth(tmp_path, rng):
+    """Regression: PS->master metadata reads must carry service
+    credentials, or replication silently no-ops under auth (found live:
+    followers stayed empty while /ps/stats looked healthy)."""
+    master = MasterServer(auth=True, root_password="pw")
+    master.start()
+    nodes = [
+        PSServer(data_dir=str(tmp_path / f"ps{i}"), master_addr=master.addr,
+                 master_auth=("root", "pw"))
+        for i in range(2)
+    ]
+    for ps in nodes:
+        ps.start()
+    router = RouterServer(master_addr=master.addr, auth=True,
+                          master_auth=("root", "pw"))
+    router.start()
+    try:
+        root = ("root", "pw")
+        rpc.call(master.addr, "POST", "/dbs/r", auth=root)
+        rpc.call(master.addr, "POST", "/dbs/r/spaces", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        }, auth=root)
+        vecs = rng.standard_normal((30, D)).astype(np.float32)
+        rpc.call(router.addr, "POST", "/document/upsert", {
+            "db_name": "r", "space_name": "s",
+            "documents": [{"_id": f"d{i}", "v": vecs[i].tolist()}
+                          for i in range(30)]}, auth=root)
+        counts = sorted(
+            eng.doc_count for ps in nodes for eng in ps.engines.values()
+        )
+        assert counts == [30, 30], f"follower stale under auth: {counts}"
+        assert all(ps.replication_errors == 0 for ps in nodes)
+    finally:
+        router.stop()
+        for ps in nodes:
+            ps.stop()
+        master.stop()
+
+
 @pytest.fixture
 def auth_cluster(tmp_path):
     master = MasterServer(auth=True, root_password="rootpw")

@@ -80,8 +80,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.role == "ps":
         from vearch_tpu.cluster.ps import PSServer
 
-        server = PSServer(data_dir=args.data_dir, host=args.host,
-                          port=args.port, master_addr=args.master_addr)
+        server = PSServer(
+            data_dir=args.data_dir, host=args.host, port=args.port,
+            master_addr=args.master_addr,
+            master_auth=("root", args.root_password) if args.auth else None,
+        )
         server.start()
         print(f"ps node {server.node_id}: http://{server.addr}", flush=True)
         stop.wait()

@@ -32,6 +32,7 @@ class PSServer:
         heartbeat_interval: float = 2.0,
         max_concurrent_searches: int = 256,
         memory_limit_mb: int = 0,
+        master_auth: tuple[str, str] | None = None,
     ):
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -39,6 +40,9 @@ class PSServer:
         self.partitions: dict[int, Partition] = {}
         self._lock = threading.Lock()
         self.master_addr = master_addr
+        # service credentials for master calls when the cluster runs with
+        # auth (replication metadata reads would otherwise 401 silently)
+        self.master_auth = master_auth
         self.node_id: int | None = None
         self.heartbeat_interval = heartbeat_interval
         self._stop = threading.Event()
@@ -48,6 +52,7 @@ class PSServer:
         # 0 = unlimited (reference: resource-limit write guard,
         # store_writer.go:82-95 -> partition flips read-only)
         self.memory_limit_mb = memory_limit_mb
+        self.replication_errors = 0  # surfaced in /ps/stats
 
         self.server = JsonRpcServer(host, port)
         s = self.server
@@ -94,6 +99,7 @@ class PSServer:
                 data = rpc.call(
                     self.master_addr, "POST", "/register",
                     {"rpc_addr": self.addr, "node_id": self.node_id},
+                    auth=self.master_auth,
                 )
                 self.node_id = data["node_id"]
                 return
@@ -107,6 +113,7 @@ class PSServer:
                 rpc.call(
                     self.master_addr, "POST", "/register",
                     {"rpc_addr": self.addr, "node_id": self.node_id},
+                    auth=self.master_auth,
                 )
             except RpcError:
                 pass
@@ -174,20 +181,35 @@ class PSServer:
         if not peers:
             return []
         try:
-            servers = rpc.call(self.master_addr, "GET", "/servers")["servers"]
+            servers = rpc.call(self.master_addr, "GET", "/servers",
+                               auth=self.master_auth)["servers"]
         except RpcError:
             return []
         by_id = {s["node_id"]: s["rpc_addr"] for s in servers}
         return [by_id[p] for p in peers if p in by_id]
 
     def _replicate(self, pid: int, path: str, body: dict) -> None:
-        for addr in self._peer_addrs(pid):
+        import sys
+
+        peers = self._peer_addrs(pid)
+        part = self.partitions.get(pid)
+        if not peers and part is not None and part.leader == self.node_id \
+                and len(part.replicas) > 1:
+            # replicas exist but none reachable/resolvable: never silent —
+            # this exact silence hid an auth misconfiguration once
+            self.replication_errors += 1
+            if self.replication_errors == 1:
+                print(f"[ps {self.node_id}] WARNING: partition {pid} has "
+                      f"replicas {part.replicas} but peer resolution "
+                      f"returned none; followers are going stale",
+                      file=sys.stderr, flush=True)
+        for addr in peers:
             try:
                 rpc.call(addr, "POST", path, {**body, "replicated": True})
-            except RpcError:
-                # follower write failure: the replica is stale until
-                # re-sync; the master's failure detector owns membership
-                pass
+            except RpcError as e:
+                self.replication_errors += 1
+                print(f"[ps {self.node_id}] replication to {addr} failed: "
+                      f"{e.msg[:80]}", file=sys.stderr, flush=True)
 
     def _h_upsert(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])
@@ -352,6 +374,7 @@ class PSServer:
     def _h_stats(self, _body, _parts) -> dict:
         return {
             "node_id": self.node_id,
+            "replication_errors": self.replication_errors,
             "partitions": {
                 str(pid): {
                     "doc_count": eng.doc_count,
