@@ -1,6 +1,8 @@
 """Backup/restore + user/role auth tests (reference:
 test_cluster_backup.py S3 backup/restore E2E; test_module_user/role)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -152,7 +154,7 @@ def test_auth_enforced(auth_cluster, rng):
     # read-only user: can read via router, cannot write master admin
     rpc.call(master.addr, "POST", "/users",
              {"name": "bob", "password": "pw", "role": "read"}, auth=root)
-    with pytest.raises(rpc.RpcError, match="read-only"):
+    with pytest.raises(rpc.RpcError, match="does not cover"):
         rpc.call(master.addr, "POST", "/dbs/db2", auth=("bob", "pw"))
     out = rpc.call(master.addr, "GET", "/dbs", auth=("bob", "pw"))
     assert [d["name"] for d in out["dbs"]] == ["db1"]
@@ -167,3 +169,135 @@ def test_auth_enforced(auth_cluster, rng):
     rpc.call(master.addr, "DELETE", "/users/bob", auth=root)
     with pytest.raises(rpc.RpcError, match="bad credentials"):
         rpc.call(master.addr, "GET", "/dbs", auth=("bob", "pw"))
+
+
+def test_router_privilege_per_route(auth_cluster, rng):
+    """ADVICE r1: the router must enforce per-endpoint privileges, not
+    just credentials — a 'read' user may search but never upsert/delete
+    (reference: doc_http.go:122 HasPermissionForResources; ParseResources
+    marks /document/{search,query} ReadOnly, other /document WriteOnly)."""
+    master, ps, router = auth_cluster
+    root = ("root", "rootpw")
+    rpc.call(master.addr, "POST", "/dbs/pdb", auth=root)
+    rpc.call(master.addr, "POST", "/dbs/pdb/spaces", {
+        "name": "s", "partition_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    }, auth=root)
+    for name, role in (("r1", "read"), ("w1", "write"), ("d1", "document")):
+        rpc.call(master.addr, "POST", "/users",
+                 {"name": name, "password": "pw", "role": role}, auth=root)
+
+    up = {"db_name": "pdb", "space_name": "s",
+          "documents": [{"_id": "a", "v": [0.5] * D}]}
+    se = {"db_name": "pdb", "space_name": "s", "limit": 1,
+          "vectors": [{"field": "v", "feature": [0.5] * D}]}
+
+    # read user: search ok, upsert/delete 403
+    rpc.call(router.addr, "POST", "/document/upsert", up, auth=root)
+    rpc.call(router.addr, "POST", "/document/search", se, auth=("r1", "pw"))
+    with pytest.raises(rpc.RpcError, match="does not cover"):
+        rpc.call(router.addr, "POST", "/document/upsert", up,
+                 auth=("r1", "pw"))
+    with pytest.raises(rpc.RpcError, match="does not cover"):
+        rpc.call(router.addr, "POST", "/document/delete",
+                 {"db_name": "pdb", "space_name": "s",
+                  "document_ids": ["a"]}, auth=("r1", "pw"))
+
+    # write user (WriteOnly): upsert ok, reads 403 (search is a read even
+    # though it rides POST; GET /dbs needs ReadOnly)
+    rpc.call(router.addr, "POST", "/document/upsert", up, auth=("w1", "pw"))
+    with pytest.raises(rpc.RpcError, match="does not cover"):
+        rpc.call(router.addr, "POST", "/document/search", se,
+                 auth=("w1", "pw"))
+    with pytest.raises(rpc.RpcError, match="does not cover"):
+        rpc.call(master.addr, "GET", "/dbs", auth=("w1", "pw"))
+
+    # document role: full document access, no db admin
+    rpc.call(router.addr, "POST", "/document/upsert", up, auth=("d1", "pw"))
+    rpc.call(router.addr, "POST", "/document/search", se, auth=("d1", "pw"))
+    with pytest.raises(rpc.RpcError, match="no privilege"):
+        rpc.call(master.addr, "POST", "/dbs/nope", auth=("d1", "pw"))
+
+    # privilege-escalation guard: a WriteOnly ResourceAll grant must not
+    # cover user/role management (w1 could otherwise mint a root user)
+    with pytest.raises(rpc.RpcError, match="admin surface"):
+        rpc.call(master.addr, "POST", "/users",
+                 {"name": "evil", "password": "x", "role": "root"},
+                 auth=("w1", "pw"))
+    with pytest.raises(rpc.RpcError, match="admin surface"):
+        rpc.call(master.addr, "POST", "/roles",
+                 {"name": "evil2", "privileges": {"ResourceAll": "WriteRead"}},
+                 auth=("w1", "pw"))
+
+
+def test_objectstore_rejects_escaping_keys(tmp_path):
+    """ADVICE r1: '<root>-evil/x' shares the string prefix with <root>
+    but escapes it; _path must use commonpath, not startswith."""
+    from vearch_tpu.cluster.objectstore import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path / "store"))
+    with pytest.raises(ValueError, match="escapes"):
+        store._path("../store-evil/x")
+    with pytest.raises(ValueError, match="escapes"):
+        store._path("a/../../outside")
+    assert store._path("a/b") == str(tmp_path / "store" / "a" / "b")
+
+
+def test_ps_backup_root_allowlist(tmp_path, rng):
+    master = MasterServer()
+    master.start()
+    allowed = str(tmp_path / "allowed")
+    ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=master.addr,
+                  backup_roots=[allowed])
+    ps.start()
+    try:
+        rpc.call(ps.addr, "POST", "/ps/partition/create", {
+            "partition": {"id": 1, "space_id": 1, "db_name": "d",
+                          "space_name": "s", "slot": 0, "replicas": [],
+                          "leader": -1},
+            "schema": {"name": "s", "fields": [
+                {"name": "v", "data_type": "vector", "dimension": D,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}}]},
+        })
+        with pytest.raises(rpc.RpcError, match="allowlist"):
+            rpc.call(ps.addr, "POST", "/ps/backup", {
+                "partition_id": 1, "store_root": str(tmp_path / "evil"),
+                "key_prefix": "x"})
+        out = rpc.call(ps.addr, "POST", "/ps/backup", {
+            "partition_id": 1, "store_root": allowed, "key_prefix": "x"})
+        assert out["partition_id"] == 1
+    finally:
+        ps.stop()
+        master.stop()
+
+
+def test_master_restart_reaps_stale_servers(tmp_path):
+    """ADVICE r1: after a master restart, persisted /server/ records must
+    get fresh leases so dead PS nodes expire through the normal reaper
+    instead of being reported alive forever."""
+    meta = str(tmp_path / "meta.json")
+    master = MasterServer(persist_path=meta, heartbeat_ttl=0.5)
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=master.addr,
+                  heartbeat_interval=0.1)
+    ps.start()
+    assert len(rpc.call(master.addr, "GET", "/servers")["servers"]) == 1
+    ps.stop()
+    master.stop()
+
+    m2 = MasterServer(persist_path=meta, heartbeat_ttl=0.5)
+    m2.start()
+    try:
+        # the dead PS never heartbeats the new master; its restored lease
+        # must expire and the record disappear
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if not rpc.call(m2.addr, "GET", "/servers")["servers"]:
+                break
+            time.sleep(0.1)
+        assert rpc.call(m2.addr, "GET", "/servers")["servers"] == []
+    finally:
+        m2.stop()

@@ -80,10 +80,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.role == "ps":
         from vearch_tpu.cluster.ps import PSServer
 
+        cfg_ps = {}
+        if args.conf:
+            from vearch_tpu.cluster.config import Config
+
+            cfg_ps = getattr(Config.load(args.conf), "ps", {}) or {}
         server = PSServer(
             data_dir=args.data_dir, host=args.host, port=args.port,
             master_addr=args.master_addr,
             master_auth=("root", args.root_password) if args.auth else None,
+            backup_roots=cfg_ps.get("backup_roots"),
         )
         server.start()
         print(f"ps node {server.node_id}: http://{server.addr}", flush=True)

@@ -1,10 +1,12 @@
-"""User/role auth: BasicAuth + privilege checks.
+"""User/role auth: BasicAuth + per-endpoint privilege checks.
 
-Mirrors the reference's auth model (reference: entity/user.go User/Role/
-Privilege; root bootstrap master/server.go:160-181; BasicAuth middleware
-cluster_api.go:252 and router doc_http.go:179). Users carry a role; roles
-grant privileges per resource: "ResourceAll", "ResourceDocument",
-"ResourceSpace", ... with operations Read/Write/All.
+Mirrors the reference's auth model (reference: entity/user.go — Privilege
+None/WriteOnly/ReadOnly/WriteRead, Resource map, ParseResources
+entity/user.go:194-260, Role.HasPermissionForResources entity/user.go:300;
+root bootstrap master/server.go:160-181; BasicAuth middleware
+cluster_api.go:153 and router doc_http.go:122). Users carry a role; roles
+grant a privilege per resource; every authenticated request is checked
+against the (resource, privilege) derived from its endpoint + method.
 """
 
 from __future__ import annotations
@@ -17,19 +19,118 @@ from vearch_tpu.cluster.rpc import RpcError
 
 ROOT_NAME = "root"
 
-PRIVI_ALL = "All"
-PRIVI_READ = "Read"
+# privilege lattice (reference: entity/user.go:29-34)
+PRIVI_NONE = "None"
 PRIVI_WRITE = "WriteOnly"
+PRIVI_READ = "ReadOnly"
+PRIVI_ALL = "WriteRead"
 
 RESOURCE_ALL = "ResourceAll"
+RESOURCE_CLUSTER = "ResourceCluster"
+RESOURCE_SERVER = "ResourceServer"
+RESOURCE_PARTITION = "ResourcePartition"
+RESOURCE_DB = "ResourceDB"
+RESOURCE_SPACE = "ResourceSpace"
 RESOURCE_DOCUMENT = "ResourceDocument"
+RESOURCE_INDEX = "ResourceIndex"
+RESOURCE_ALIAS = "ResourceAlias"
+RESOURCE_USER = "ResourceUser"
+RESOURCE_ROLE = "ResourceRole"
+RESOURCE_CONFIG = "ResourceConfig"
 
+# builtin roles (reference: entity/user.go RoleMap — root/ClusterAdmin/
+# SpaceAdmin/DocumentAdmin...; the short "read"/"write"/"document" names
+# are kept for the SDK surface, with reference-faithful grants: "write"
+# carries WriteOnly, not admin)
 BUILTIN_ROLES = {
     "root": {RESOURCE_ALL: PRIVI_ALL},
     "read": {RESOURCE_ALL: PRIVI_READ},
-    "write": {RESOURCE_ALL: PRIVI_ALL},
-    "document": {RESOURCE_DOCUMENT: PRIVI_ALL},
+    "write": {RESOURCE_ALL: PRIVI_WRITE},
+    "document": {RESOURCE_DOCUMENT: PRIVI_ALL, RESOURCE_INDEX: PRIVI_ALL},
+    "defaultClusterAdmin": {
+        RESOURCE_CLUSTER: PRIVI_ALL, RESOURCE_SERVER: PRIVI_ALL,
+        RESOURCE_PARTITION: PRIVI_ALL, RESOURCE_DB: PRIVI_ALL,
+        RESOURCE_SPACE: PRIVI_ALL, RESOURCE_DOCUMENT: PRIVI_ALL,
+        RESOURCE_INDEX: PRIVI_ALL, RESOURCE_ALIAS: PRIVI_ALL,
+        RESOURCE_CONFIG: PRIVI_ALL, RESOURCE_USER: PRIVI_ALL,
+        RESOURCE_ROLE: PRIVI_ALL,
+    },
+    "defaultSpaceAdmin": {
+        RESOURCE_SPACE: PRIVI_ALL, RESOURCE_DOCUMENT: PRIVI_ALL,
+        RESOURCE_INDEX: PRIVI_ALL, RESOURCE_ALIAS: PRIVI_READ,
+    },
+    "defaultDocumentAdmin": {
+        RESOURCE_DOCUMENT: PRIVI_ALL, RESOURCE_INDEX: PRIVI_ALL,
+    },
 }
+
+
+def parse_resources(endpoint: str, method: str) -> tuple[str, str]:
+    """Map (endpoint, method) -> (resource, required privilege)
+    (reference: entity/user.go:194 ParseResources). GET needs ReadOnly,
+    everything else WriteOnly — except /document/{search,query} which are
+    reads that ride POST."""
+    privilege = PRIVI_READ if method == "GET" else PRIVI_WRITE
+    e = endpoint
+    if e.startswith("/cluster") or e == "/":
+        return RESOURCE_CLUSTER, privilege
+    if e.startswith("/servers") or e.startswith("/register"):
+        return RESOURCE_SERVER, privilege
+    if e.startswith("/partitions"):
+        return RESOURCE_PARTITION, privilege
+    if e.startswith("/dbs"):
+        return (RESOURCE_SPACE if "/spaces" in e else RESOURCE_DB), privilege
+    if e.startswith("/backup"):
+        return RESOURCE_SPACE, privilege
+    if e.startswith("/document"):
+        if "query" in e or "search" in e:
+            return RESOURCE_DOCUMENT, PRIVI_READ
+        return RESOURCE_DOCUMENT, PRIVI_WRITE
+    if e.startswith("/index"):
+        return RESOURCE_INDEX, privilege
+    if e.startswith("/alias"):
+        return RESOURCE_ALIAS, privilege
+    if e.startswith("/config"):
+        return RESOURCE_CONFIG, privilege
+    if e.startswith("/users") or e.startswith("/user"):
+        return RESOURCE_USER, privilege
+    if e.startswith("/roles") or e.startswith("/role"):
+        return RESOURCE_ROLE, privilege
+    return RESOURCE_ALL, privilege
+
+
+def has_permission(role_name: str, privileges: dict[str, str],
+                   endpoint: str, method: str) -> None:
+    """Raise 403 unless the role's grants cover the endpoint (reference:
+    entity/user.go:300 HasPermissionForResources — root bypasses; a grant
+    matches when equal to the need or WriteRead)."""
+    if role_name == ROOT_NAME:
+        return
+    resource, needed = parse_resources(endpoint, method)
+    grant = privileges.get(resource)
+    if grant is None:
+        grant = privileges.get(RESOURCE_ALL)
+        if grant is None:
+            raise RpcError(
+                403, f"role {role_name!r} has no privilege on {resource}"
+            )
+        # user/role management is admin surface: a blanket ResourceAll
+        # grant below WriteRead must not cover it, or a WriteOnly data
+        # user could POST /users a root-role account and escalate
+        # (reference: user management is ClusterAdmin/root-only)
+        if resource in (RESOURCE_USER, RESOURCE_ROLE) and grant != PRIVI_ALL:
+            raise RpcError(
+                403,
+                f"role {role_name!r} ResourceAll grant {grant} does not "
+                f"extend to {resource} (admin surface)",
+            )
+    if grant == needed or grant == PRIVI_ALL:
+        return
+    raise RpcError(
+        403,
+        f"role {role_name!r} privilege {grant} on {resource} does not "
+        f"cover {needed} for {method} {endpoint}",
+    )
 
 
 def hash_password(password: str, salt: str | None = None) -> str:
@@ -104,10 +205,7 @@ class AuthService:
         return {"name": user, "role": u["role"],
                 "privileges": role["privileges"]}
 
-    def authorize(self, privileges: dict[str, str], resource: str,
-                  write: bool) -> None:
-        grant = privileges.get(resource) or privileges.get(RESOURCE_ALL)
-        if grant is None:
-            raise RpcError(403, f"no privilege on {resource}")
-        if write and grant == PRIVI_READ:
-            raise RpcError(403, f"read-only privilege on {resource}")
+    def authorize(self, record: dict, endpoint: str, method: str) -> None:
+        """Per-request privilege check on a record returned by check()."""
+        has_permission(record.get("role", ""),
+                       record.get("privileges") or {}, endpoint, method)

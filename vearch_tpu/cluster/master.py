@@ -52,12 +52,20 @@ class MasterServer:
         self.auth_service = AuthService(self.store, root_password)
 
         def authenticator(headers, method, path):
+            # per-endpoint privilege enforcement (reference:
+            # cluster_api.go:153 role.HasPermissionForResources)
             user, password = parse_basic_auth(headers)
             record = self.auth_service.check(user, password)
-            self.auth_service.authorize(
-                record["privileges"], "ResourceAll",
-                write=method != "GET",
-            )
+            self.auth_service.authorize(record, path, method)
+
+        # a restarted master has persisted /server/ records but empty
+        # in-memory leases; grant each a fresh short lease so dead nodes
+        # expire through the normal reaper instead of living forever
+        for key, val in self.store.prefix(PREFIX_SERVER).items():
+            node_id = int(key[len(PREFIX_SERVER):])
+            lease = self.store.grant_lease(self.heartbeat_ttl)
+            self._leases[node_id] = lease
+            self.store.put(key, val, lease=lease)
 
         self.server = JsonRpcServer(
             host,

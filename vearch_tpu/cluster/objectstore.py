@@ -15,6 +15,14 @@ import os
 import shutil
 
 
+def is_within(root: str, path: str) -> bool:
+    """True when `path` resolves inside `root` (commonpath, not string
+    prefix: '<root>-evil/x' shares the prefix but not the directory)."""
+    root = os.path.abspath(root)
+    path = os.path.abspath(path)
+    return os.path.commonpath([root, path]) == root
+
+
 class ObjectStore:
     def put_file(self, key: str, local_path: str) -> None:
         raise NotImplementedError
@@ -32,8 +40,10 @@ class LocalObjectStore(ObjectStore):
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        path = os.path.normpath(os.path.join(self.root, key.lstrip("/")))
-        if not path.startswith(os.path.abspath(self.root)) and not path.startswith(self.root):
+        path = os.path.abspath(
+            os.path.join(os.path.abspath(self.root), key.lstrip("/"))
+        )
+        if not is_within(self.root, path):
             raise ValueError(f"key escapes store root: {key}")
         return path
 

@@ -33,6 +33,7 @@ class PSServer:
         max_concurrent_searches: int = 256,
         memory_limit_mb: int = 0,
         master_auth: tuple[str, str] | None = None,
+        backup_roots: list[str] | None = None,
     ):
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -52,6 +53,14 @@ class PSServer:
         # 0 = unlimited (reference: resource-limit write guard,
         # store_writer.go:82-95 -> partition flips read-only)
         self.memory_limit_mb = memory_limit_mb
+        # operator allowlist for backup/restore store roots: when set,
+        # /ps/backup and /ps/restore refuse store_root paths outside it
+        # (anyone reaching the PS port could otherwise read/write
+        # arbitrary filesystem paths through the object store)
+        self.backup_roots = (
+            [os.path.abspath(r) for r in backup_roots] if backup_roots
+            else None
+        )
         self.replication_errors = 0  # surfaced in /ps/stats
 
         self.server = JsonRpcServer(host, port)
@@ -339,6 +348,17 @@ class PSServer:
     # -- backup/restore (reference: ps/backup/ps_backup_service.go:77
     #    PSShardManager — shard dump streamed to object storage) -------------
 
+    def _check_backup_root(self, store_root: str) -> None:
+        from vearch_tpu.cluster.objectstore import is_within
+
+        if self.backup_roots is None:
+            return
+        if any(is_within(allowed, store_root)
+               for allowed in self.backup_roots):
+            return
+        raise RpcError(403, f"store_root {store_root!r} not in the "
+                            f"operator backup_roots allowlist")
+
     def _h_backup(self, body: dict, _parts) -> dict:
         import tempfile
 
@@ -346,6 +366,7 @@ class PSServer:
 
         pid = int(body["partition_id"])
         eng = self._engine(pid)
+        self._check_backup_root(body["store_root"])
         store = LocalObjectStore(body["store_root"])
         with tempfile.TemporaryDirectory() as tmp:
             eng.dump(tmp)
@@ -359,6 +380,7 @@ class PSServer:
 
         pid = int(body["partition_id"])
         eng = self._engine(pid)  # partition must exist (space created first)
+        self._check_backup_root(body["store_root"])
         store = LocalObjectStore(body["store_root"])
         data_dir = os.path.join(self.data_dir, f"partition_{pid}")
         shutil.rmtree(data_dir, ignore_errors=True)

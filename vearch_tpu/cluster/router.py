@@ -38,7 +38,7 @@ class RouterServer:
         self.master_auth = master_auth
         self._space_cache: dict[str, tuple[float, Space]] = {}
         self._server_cache: tuple[float, dict[int, Server]] = (0.0, {})
-        self._auth_cache: dict[tuple[str, str], float] = {}
+        self._auth_cache: dict[tuple[str, str], tuple[float, dict]] = {}
         self._cache_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=32)
 
@@ -164,20 +164,27 @@ class RouterServer:
                             {**body, "partition_id": pid})
 
     def _authenticate(self, headers, method, path) -> None:
-        """BasicAuth via the master's /auth/check, positively cached 5s
-        (reference: router doc_http.go:179 BasicAuth middleware)."""
-        from vearch_tpu.cluster.auth import parse_basic_auth
+        """BasicAuth via the master's /auth/check (positively cached 5s)
+        plus per-endpoint privilege enforcement (reference: router
+        doc_http.go:122 role.HasPermissionForResources — a 'read' user
+        may search but not upsert/delete)."""
+        from vearch_tpu.cluster.auth import has_permission, parse_basic_auth
 
         user, password = parse_basic_auth(headers)
         key = (user, password)
         now = time.time()
+        record = None
         with self._cache_lock:
-            if self._auth_cache.get(key, 0.0) > now:
-                return
-        rpc.call(self.master_addr, "POST", "/auth/check",
-                 {"name": user, "password": password})
-        with self._cache_lock:
-            self._auth_cache[key] = now + 5.0
+            hit = self._auth_cache.get(key)
+            if hit and hit[0] > now:
+                record = hit[1]
+        if record is None:
+            record = rpc.call(self.master_addr, "POST", "/auth/check",
+                              {"name": user, "password": password})
+            with self._cache_lock:
+                self._auth_cache[key] = (now + 5.0, record)
+        has_permission(record.get("role", ""),
+                       record.get("privileges") or {}, path, method)
 
     def _master_call(self, method: str, path: str, body=None):
         return rpc.call(self.master_addr, method, path, body,

@@ -498,18 +498,32 @@ class Engine:
         dirpath = dirpath or self.data_dir
         assert dirpath, "no data_dir configured"
         os.makedirs(dirpath, exist_ok=True)
+        # two-phase: snapshot under the write lock (cheap — pointer copies
+        # and stable views of append-only arrays), then write to disk with
+        # the lock released so multi-GB dumps don't stall writers. A torn
+        # snapshot (keys longer than columns after load) was the original
+        # bug; lock-free disk writes are safe because every store is
+        # append-only with copy-on-grow.
+        with self._write_lock:
+            table_snap = self.table.snapshot()
+            bits = self.bitmap._bits[: max(len(table_snap["keys"]), 1)].copy()
+            vec_views = {
+                name: store.host_view()
+                for name, store in self.vector_stores.items()
+            }
+            status = int(self.status)
         with open(os.path.join(dirpath, "schema.json"), "w") as f:
             json.dump(self.schema.to_dict(), f)
-        self.table.dump(os.path.join(dirpath, "table"))
-        self.bitmap.dump(os.path.join(dirpath, "bitmap.npy"))
-        for name, store in self.vector_stores.items():
-            store.dump(os.path.join(dirpath, f"vectors_{name}.npy"))
+        self.table.dump_snapshot(table_snap, os.path.join(dirpath, "table"))
+        np.save(os.path.join(dirpath, "bitmap.npy"), bits)
+        for name, view in vec_views.items():
+            np.save(os.path.join(dirpath, f"vectors_{name}.npy"), view)
         for name, index in self.indexes.items():
             state = index.dump_state()
             if state:
                 np.savez(os.path.join(dirpath, f"index_{name}.npz"), **state)
         with open(os.path.join(dirpath, "engine.json"), "w") as f:
-            json.dump({"status": int(self.status)}, f)
+            json.dump({"status": status}, f)
 
     def load(self, dirpath: str | None = None) -> None:
         dirpath = dirpath or self.data_dir

@@ -124,19 +124,34 @@ class Table:
 
     # -- persistence ---------------------------------------------------------
 
-    def dump(self, dirpath: str) -> None:
+    def snapshot(self) -> dict:
+        """Consistent point-in-time capture, O(n) pointer copies only.
+
+        Caller must hold the engine write lock for the call; the returned
+        snapshot may then be written to disk lock-free: columns and keys
+        are append-only (growth reallocates, so captured views never see
+        later writes), and the mutable dict is copied here.
+        """
+        return {
+            "keys": list(self._keys),
+            "key_to_docid": dict(self._key_to_docid),
+            "strings": {k: list(v) for k, v in self._strings.items()},
+            "fixed": {name: col.view() for name, col in self._fixed.items()},
+        }
+
+    def dump_snapshot(self, snap: dict, dirpath: str) -> None:
         os.makedirs(dirpath, exist_ok=True)
-        np.savez(
-            os.path.join(dirpath, "columns.npz"),
-            **{name: col.view() for name, col in self._fixed.items()},
-        )
+        np.savez(os.path.join(dirpath, "columns.npz"), **snap["fixed"])
         meta = {
-            "keys": self._keys,
-            "key_to_docid": self._key_to_docid,
-            "strings": self._strings,
+            "keys": snap["keys"],
+            "key_to_docid": snap["key_to_docid"],
+            "strings": snap["strings"],
         }
         with open(os.path.join(dirpath, "table.json"), "w") as f:
             json.dump(meta, f)
+
+    def dump(self, dirpath: str) -> None:
+        self.dump_snapshot(self.snapshot(), dirpath)
 
     def load(self, dirpath: str) -> None:
         with open(os.path.join(dirpath, "table.json")) as f:

@@ -33,9 +33,17 @@ _SRC = os.path.join(
     "vearch_native.cpp",
 )
 _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "vearch_native.so")
+_HASH = _SO + ".srchash"  # sha256 of the source the .so was built from
 
 
-def _build() -> bool:
+def _src_hash() -> str:
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build(src_hash: str) -> bool:
     include = sysconfig.get_paths()["include"]
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
@@ -43,9 +51,25 @@ def _build() -> bool:
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        with open(_HASH, "w") as f:
+            f.write(src_hash)
         return True
     except Exception:
         return False
+
+
+def _stale() -> tuple[bool, str]:
+    """The .so is never committed (gitignored); it is rebuilt whenever the
+    recorded source hash mismatches, so an unreviewable stale binary can't
+    shadow reviewed csrc changes (mtimes are useless after a fresh clone —
+    every file gets the checkout time)."""
+    if not os.path.exists(_SRC):
+        return False, ""
+    h = _src_hash()
+    if not os.path.exists(_SO) or not os.path.exists(_HASH):
+        return True, h
+    with open(_HASH) as f:
+        return f.read().strip() != h, h
 
 
 def _load():
@@ -54,11 +78,9 @@ def _load():
         if _mod is not None or _tried:
             return _mod
         _tried = True
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
-            if not _build():
+        stale, h = _stale()
+        if not os.path.exists(_SO) or stale:
+            if not os.path.exists(_SRC) or not _build(h):
                 return None
         try:
             spec = importlib.util.spec_from_file_location("vearch_native", _SO)
