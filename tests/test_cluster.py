@@ -191,3 +191,115 @@ def test_drop_space_and_db(client):
     client.drop_space("db1", "tmp_space")
     with pytest.raises(Exception, match="not found"):
         client.get_space("db1", "tmp_space")
+
+
+def test_global_pagination_across_partitions(client):
+    """r1 VERDICT weak-7: page 2 of a filtered query must continue the
+    global _id order, not skip `offset` docs per shard."""
+    client.create_space("db1", {
+        "name": "pages", "partition_num": 3,
+        "fields": [
+            {"name": "grp", "data_type": "integer"},
+            {"name": "v", "data_type": "vector", "dimension": 4,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    client.upsert("db1", "pages", [
+        {"_id": f"k{i:03d}", "grp": 1, "v": [float(i), 0.0, 0.0, 0.0]}
+        for i in range(40)
+    ])
+    flt = {"operator": "AND",
+           "conditions": [{"field": "grp", "operator": "=", "value": 1}]}
+    pages = [
+        [d["_id"] for d in client.query("db1", "pages", filters=flt,
+                                        limit=10, offset=off)]
+        for off in (0, 10, 20, 30)
+    ]
+    got = [k for page in pages for k in page]
+    assert got == [f"k{i:03d}" for i in range(40)], got
+    # past-the-end page is empty, not an error
+    assert client.query("db1", "pages", filters=flt, limit=10, offset=40) == []
+    client.drop_space("db1", "pages")
+
+
+def test_delete_by_filter_drains_past_batch_cap(cluster, client):
+    """r1 VERDICT weak-8: delete-by-filter must drain every match, not
+    silently stop at the 10k query batch."""
+    client.create_space("db1", {
+        "name": "drain", "partition_num": 1,
+        "fields": [
+            {"name": "grp", "data_type": "integer"},
+            {"name": "v", "data_type": "vector", "dimension": 4,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    n = 12_000  # crosses the 10k per-query batch
+    for start in range(0, n, 3000):
+        client.upsert("db1", "drain", [
+            {"_id": f"d{i}", "grp": 7, "v": [0.1, 0.2, 0.3, 0.4]}
+            for i in range(start, min(start + 3000, n))
+        ])
+    flt = {"operator": "AND",
+           "conditions": [{"field": "grp", "operator": "=", "value": 7}]}
+    # explicit limit still bounds the delete
+    assert client.delete("db1", "drain", filters=flt, limit=5) == 5
+    # unbounded delete drains everything that remains
+    assert client.delete("db1", "drain", filters=flt) == n - 5
+    assert client.query("db1", "drain", filters=flt, limit=10) == []
+    client.drop_space("db1", "drain")
+
+
+def test_delete_limit_is_global_across_partitions(client):
+    """An explicit delete limit bounds the TOTAL, not per shard (found by
+    driving the live server: parallel fan-out deleted limit×partitions)."""
+    client.create_space("db1", {
+        "name": "dlim", "partition_num": 3,
+        "fields": [
+            {"name": "grp", "data_type": "integer"},
+            {"name": "v", "data_type": "vector", "dimension": 4,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    client.upsert("db1", "dlim", [
+        {"_id": f"x{i}", "grp": 2, "v": [0.0] * 4} for i in range(90)
+    ])
+    flt = {"operator": "AND",
+           "conditions": [{"field": "grp", "operator": "=", "value": 2}]}
+    assert client.delete("db1", "dlim", filters=flt, limit=10) == 10
+    assert client.delete("db1", "dlim", filters=flt) == 80
+    client.drop_space("db1", "dlim")
+
+
+def test_pagination_insertion_order_independent(client):
+    """Docs inserted in descending _id order must still paginate in
+    ascending global _id order (shards sort matches by key, so the
+    router's merge-then-slice is correct; review r2 finding)."""
+    client.create_space("db1", {
+        "name": "revpages", "partition_num": 2,
+        "fields": [
+            {"name": "grp", "data_type": "integer"},
+            {"name": "v", "data_type": "vector", "dimension": 4,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    # reverse insertion order
+    client.upsert("db1", "revpages", [
+        {"_id": f"z{i:02d}", "grp": 1, "v": [0.0] * 4}
+        for i in reversed(range(30))
+    ])
+    flt = {"operator": "AND",
+           "conditions": [{"field": "grp", "operator": "=", "value": 1}]}
+    got = []
+    for off in (0, 10, 20):
+        got += [d["_id"] for d in client.query("db1", "revpages",
+                                               filters=flt, limit=10,
+                                               offset=off)]
+    assert got == [f"z{i:02d}" for i in range(30)], got
+    # limit=0 deletes nothing (falsy-zero regression)
+    assert client.delete("db1", "revpages", filters=flt, limit=0) == 0
+    assert len(client.query("db1", "revpages", filters=flt, limit=50)) == 30
+    client.drop_space("db1", "revpages")

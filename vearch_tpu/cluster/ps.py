@@ -250,15 +250,30 @@ class PSServer:
                 self._replicate(pid, "/ps/doc/delete",
                                 {"partition_id": pid, "keys": body["keys"]})
             return {"deleted": deleted}
-        # delete-by-filter (reference: /document/delete with filters)
-        docs = eng.query(body.get("filters"), limit=body.get("limit", 10_000),
-                         include_fields=[])
-        keys = [d["_id"] for d in docs]
-        deleted = eng.delete(keys)
-        if keys and not body.get("replicated"):
-            self._replicate(pid, "/ps/doc/delete",
-                            {"partition_id": pid, "keys": keys})
-        return {"deleted": deleted, "keys": keys}
+        # delete-by-filter (reference: /document/delete with filters).
+        # Drain in batches until no matches remain — a single capped
+        # query would silently delete only the first 10k of a larger
+        # match set (r1 VERDICT weak-8). An explicit client `limit`
+        # still bounds the total.
+        limit = int(body["limit"]) if body.get("limit") is not None else None
+        batch = 10_000
+        deleted = 0
+        while True:
+            want = batch if limit is None else min(batch, limit - deleted)
+            if want <= 0:
+                break
+            docs = eng.query(body.get("filters"), limit=want,
+                             include_fields=[], order_by_key=False)
+            if not docs:
+                break
+            keys = [d["_id"] for d in docs]
+            deleted += eng.delete(keys)
+            if not body.get("replicated"):
+                self._replicate(pid, "/ps/doc/delete",
+                                {"partition_id": pid, "keys": keys})
+            if len(docs) < want:
+                break
+        return {"deleted": deleted}
 
     def _h_get(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])

@@ -56,6 +56,11 @@ class BitmapManager:
         self._ensure(max(n - 1, 0))
         return ~self._bits[:n]
 
+    def snapshot(self, n: int) -> np.ndarray:
+        """Point-in-time copy of the first n bits (caller holds the
+        engine write lock; the copy may be persisted lock-free)."""
+        return self._bits[: max(n, 1)].copy()
+
     def dump(self, path: str) -> None:
         np.save(path, self._bits)
 

@@ -180,17 +180,30 @@ class Engine:
         offset: int = 0,
         include_fields: list[str] | None = None,
         vector_value: bool = False,
+        order_by_key: bool = True,
     ) -> list[dict]:
         """Scalar-only query: filter docs without vector search
         (reference: engine.cc:404 ScalarIndexQuery-only path +
-        /document/query). Vector payload rules match get()."""
+        /document/query). Vector payload rules match get().
+
+        Matches are returned in _id order by default so the router's
+        merge-then-slice global pagination is correct regardless of
+        insertion order; pass order_by_key=False for drain-style callers
+        (delete-by-filter) that don't care and shouldn't pay the sort.
+        """
         n = self.table.doc_count
         valid = self.bitmap.valid_mask(n)
         if filters is not None:
             from vearch_tpu.scalar.filter import evaluate_filter
 
             valid = valid & evaluate_filter(filters, self, n)
-        hits = np.nonzero(valid)[0][offset : offset + limit]
+        matched = np.nonzero(valid)[0]
+        if order_by_key and matched.size:
+            keys = np.array(
+                [self.table.key_of(int(i)) for i in matched], dtype=object
+            )
+            matched = matched[np.argsort(keys, kind="stable")]
+        hits = matched[offset : offset + limit]
         out = []
         for docid in hits:
             docid = int(docid)
@@ -506,7 +519,7 @@ class Engine:
         # append-only with copy-on-grow.
         with self._write_lock:
             table_snap = self.table.snapshot()
-            bits = self.bitmap._bits[: max(len(table_snap["keys"]), 1)].copy()
+            bits = self.bitmap.snapshot(len(table_snap["keys"]))
             vec_views = {
                 name: store.host_view()
                 for name, store in self.vector_stores.items()

@@ -103,11 +103,13 @@ class VearchClient:
         document_ids: list[str] | None = None,
         filters: dict | None = None,
         limit: int = 50,
+        offset: int = 0,
         fields: list[str] | None = None,
         vector_value: bool = False,
     ) -> list[dict]:
         body: dict[str, Any] = {"db_name": db_name, "space_name": space_name,
-                                "limit": limit, "vector_value": vector_value}
+                                "limit": limit, "offset": offset,
+                                "vector_value": vector_value}
         if document_ids:
             body["document_ids"] = document_ids
         if filters:
@@ -122,12 +124,15 @@ class VearchClient:
         space_name: str,
         document_ids: list[str] | None = None,
         filters: dict | None = None,
+        limit: int | None = None,
     ) -> int:
         body: dict[str, Any] = {"db_name": db_name, "space_name": space_name}
         if document_ids:
             body["document_ids"] = document_ids
         if filters:
             body["filters"] = filters
+        if limit is not None:
+            body["limit"] = limit
         return rpc.call(self.addr, "POST", "/document/delete", body)["total"]
 
     def flush(self, db_name: str, space_name: str) -> dict:
