@@ -1,0 +1,149 @@
+"""Process-level failure injection: real PS processes, kill -9
+(reference: test/test_cluster_ps.py drives `docker stop`/`docker start`
+of PS containers; here SIGKILL of real `python -m vearch_tpu --role ps`
+subprocesses — same fail-stop semantics, no containers needed)."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.master import MasterServer
+from vearch_tpu.cluster.router import RouterServer
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def spawn_ps(data_dir: str, master_addr: str) -> tuple[subprocess.Popen, int]:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vearch_tpu", "--role", "ps",
+         "--data-dir", data_dir, "--master-addr", master_addr],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env,
+    )
+    line = proc.stdout.readline()  # "ps node N: http://host:port"
+    m = re.match(r"ps node (\d+):", line)
+    assert m, f"unexpected ps banner: {line!r}"
+    return proc, int(m.group(1))
+
+
+@pytest.mark.slow
+def test_kill9_leader_loses_no_acked_write(tmp_path, rng):
+    """SIGKILL the leader PS process mid-ingest: every write the client
+    got an ack for must survive failover (round-1 'done when' #1, at
+    the process level — no in-process shortcuts)."""
+    master = MasterServer(heartbeat_ttl=2.0, recover_delay=3600.0)
+    master.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    procs = []
+    try:
+        p1, nid1 = spawn_ps(str(tmp_path / "ps0"), master.addr)
+        procs.append(p1)
+        p2, nid2 = spawn_ps(str(tmp_path / "ps1"), master.addr)
+        procs.append(p2)
+
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        sp = cl.get_space("db", "s")["partitions"][0]
+        leader_nid = sp["leader"]
+        leader_proc = p1 if nid1 == leader_nid else p2
+
+        vecs = rng.standard_normal((60, D)).astype(np.float32)
+        acked = []
+        for i in range(60):
+            cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}])
+            acked.append(f"d{i}")
+
+        # kill -9: no flush, no cleanup, nothing graceful
+        leader_proc.send_signal(signal.SIGKILL)
+        leader_proc.wait(timeout=10)
+
+        # failover: writes resume against the promoted follower
+        deadline = time.time() + 30
+        post_ok = False
+        while time.time() < deadline:
+            try:
+                cl.upsert("db", "s", [{"_id": "post", "v": vecs[0]}])
+                post_ok = True
+                break
+            except rpc.RpcError:
+                time.sleep(0.4)
+        assert post_ok, "writes did not resume after kill -9 failover"
+
+        docs = cl.query("db", "s", document_ids=acked)
+        got = {d["_id"] for d in docs}
+        missing = set(acked) - got
+        assert not missing, f"ACKED WRITES LOST after kill -9: {sorted(missing)[:10]}"
+
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[33]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d33"
+    finally:
+        router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        master.stop()
+
+
+@pytest.mark.slow
+def test_kill9_restart_recovers_from_wal(tmp_path, rng):
+    """SIGKILL a single-replica PS, restart the process on the same
+    data dir: the WAL replays every acked write (durability 'done
+    when': crash loses at most the un-acked tail)."""
+    master = MasterServer(heartbeat_ttl=2.0)
+    master.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    proc = None
+    try:
+        proc, _nid = spawn_ps(str(tmp_path / "ps0"), master.addr)
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 1,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((40, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(40)])
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        proc, _ = spawn_ps(str(tmp_path / "ps0"), master.addr)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                docs = cl.query("db", "s",
+                                document_ids=[f"d{i}" for i in range(40)])
+                if len(docs) == 40:
+                    break
+            except rpc.RpcError:
+                pass
+            time.sleep(0.4)
+        assert len(docs) == 40, f"WAL replay recovered {len(docs)}/40"
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[21]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d21"
+    finally:
+        router.stop()
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        master.stop()

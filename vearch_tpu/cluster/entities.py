@@ -33,6 +33,9 @@ class Partition:
     slot: int  # slot range start (reference: entity/partition.go Slot)
     replicas: list[int] = field(default_factory=list)  # node ids
     leader: int = -1  # node id of raft leader
+    # raft leadership epoch: bumped by the master on every failover /
+    # membership change; fences deposed leaders (raft.py)
+    term: int = 1
 
     def to_dict(self) -> dict[str, Any]:
         return dict(self.__dict__)

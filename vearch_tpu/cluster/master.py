@@ -42,13 +42,22 @@ class MasterServer:
         heartbeat_ttl: float = HEARTBEAT_TTL,
         auth: bool = False,
         root_password: str = "secret",
+        auto_recover: bool = True,
+        recover_delay: float = 5.0,
     ):
         from vearch_tpu.cluster.auth import AuthService, parse_basic_auth
 
         self.heartbeat_ttl = heartbeat_ttl
+        self.auto_recover = auto_recover
+        self.recover_delay = recover_delay
         self.store = MetaStore(persist_path)
         self._stop = threading.Event()
         self._leases: dict[int, int] = {}  # node_id -> lease id
+        # serialises every partition reconfiguration (lease-reaper
+        # failover, auto-recover loop, /partitions/change_member):
+        # two concurrent reconfigs could fence at the same term and
+        # appoint two leaders, defeating the fencing safety argument
+        self._reconfig_lock = threading.Lock()
         self.auth_service = AuthService(self.store, root_password)
 
         def authenticator(headers, method, path):
@@ -89,6 +98,7 @@ class MasterServer:
         s.route("GET", "/dbs", self._h_get_db)
         s.route("DELETE", "/dbs", self._h_delete_db)
         s.route("GET", "/partitions", self._h_partitions)
+        s.route("POST", "/partitions/change_member", self._h_change_member)
         s.route("POST", "/config", self._h_set_config)
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)
@@ -99,6 +109,9 @@ class MasterServer:
     def start(self) -> None:
         self.server.start()
         threading.Thread(target=self._lease_reaper, daemon=True).start()
+        if self.auto_recover:
+            threading.Thread(target=self._auto_recover_loop,
+                             daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -125,21 +138,218 @@ class MasterServer:
                     self._failover_node(node_id)
 
     def _failover_node(self, dead_node: int) -> None:
-        """Promote the first alive follower of every partition the dead
-        node led (reference: auto-recover re-placement,
-        services/server_service.go:95 — raft elects; here the master
-        promotes since replication v0 is primary-backup)."""
-        alive = {s.node_id for s in self._alive_servers()}
+        """Reconfigure every partition hosted on the dead node: fence all
+        reachable replicas with a bumped term, promote the one with the
+        max (last_term, last_index) log, and remove the dead node from
+        the membership so quorum is computable again (reference:
+        raft election + ChangeMember, services/server_service.go:95).
+
+        Safety: promotion requires that the alive replicas intersect
+        every possible commit quorum of the old membership — i.e. at
+        least n - quorum(n) + 1 of n replicas reachable. The max-log
+        replica among such a set necessarily holds every committed
+        (acked) entry, so promotion never loses an acked write. Below
+        that threshold the partition stays unavailable (leaderless)
+        rather than silently dropping acked data."""
+        servers = {s.node_id: s for s in self._alive_servers()}
+        with self._reconfig_lock:
+            for key, sp in self.store.prefix(PREFIX_SPACE).items():
+                changed = False
+                for p in sp["partitions"]:
+                    if dead_node not in p["replicas"]:
+                        continue
+                    if self._reconfigure_partition(p, servers,
+                                                   drop=dead_node):
+                        changed = True
+                if changed:
+                    self.store.put(key, sp)
+
+    def _reconfigure_partition(self, p: dict, servers: dict,
+                               drop: int | None = None) -> bool:
+        """Fence alive replicas, pick the best leader, decree the new
+        membership. Mutates the partition dict in place; returns whether
+        anything changed."""
+        replicas = list(p["replicas"])
+        n = len(replicas)
+        quorum = n // 2 + 1
+        new_term = int(p.get("term", 1)) + 1
+        states = {}
+        for r in replicas:
+            srv = servers.get(r)
+            if srv is None or (drop is not None and r == drop):
+                continue
+            try:
+                states[r] = rpc.call(srv.rpc_addr, "POST", "/ps/raft/fence",
+                                     {"pid": p["id"], "term": new_term})
+            except RpcError:
+                continue
+        # commit-quorum intersection bound (see _failover_node docstring)
+        if len(states) < n - quorum + 1 or not states:
+            return False
+        best = max(
+            states,
+            key=lambda r: (states[r]["last_term"], states[r]["last_index"]),
+        )
+        members = sorted(states)
+        p["leader"] = best
+        p["term"] = new_term
+        p["replicas"] = members
+        try:
+            rpc.call(servers[best].rpc_addr, "POST", "/ps/raft/lead",
+                     {"pid": p["id"], "term": new_term, "members": members})
+        except RpcError:
+            return False
+        for r in members:
+            if r == best:
+                continue
+            try:
+                rpc.call(servers[r].rpc_addr, "POST", "/ps/raft/members",
+                         {"pid": p["id"], "term": new_term,
+                          "members": members, "leader": best})
+            except RpcError:
+                pass
+        return True
+
+    # -- auto-recover: re-place lost replicas (reference: AutoRecoverPs
+    #    loop, client/master_cache.go:1154; ChangeMember to a healthy PS
+    #    after replica_auto_recover_time) -----------------------------------
+
+    def _auto_recover_loop(self) -> None:
+        import sys
+
+        while not self._stop.is_set():
+            time.sleep(1.0)
+            try:
+                with self._reconfig_lock:
+                    self._auto_recover_once()
+            except Exception as e:
+                print(f"[master] auto-recover pass failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+
+    def _auto_recover_once(self) -> None:
+        servers = {s.node_id: s for s in self._alive_servers()}
+        if not servers:
+            return
+        # replica re-placement only counts after the failure has aged
+        # past recover_delay (a restarting node should rejoin, not be
+        # rebuilt); leaderless reconciliation below runs regardless
+        fails = self.store.prefix("/fail_server/")
+        may_replace = not any(
+            time.time() - v["time"] < self.recover_delay
+            for v in fails.values()
+        )
         for key, sp in self.store.prefix(PREFIX_SPACE).items():
+            replica_num = int(sp.get("replica_num", 1))
             changed = False
             for p in sp["partitions"]:
-                if p["leader"] == dead_node:
-                    candidates = [r for r in p["replicas"] if r in alive]
-                    if candidates:
-                        p["leader"] = candidates[0]
+                # leaderless reconciliation: lease expiry fires failover
+                # once; if promotion was unsafe then (too few alive
+                # replicas to cover the commit quorum), retry here as
+                # nodes return
+                if p["leader"] not in servers and any(
+                    r in servers for r in p["replicas"]
+                ):
+                    if self._reconfigure_partition(p, servers,
+                                                   drop=p["leader"]):
                         changed = True
+            for p in sp["partitions"]:
+                if not may_replace:
+                    break
+                if len(p["replicas"]) >= replica_num:
+                    continue
+                if p["leader"] not in servers:
+                    continue  # no live leader to copy from
+                candidates = [
+                    s for nid, s in servers.items()
+                    if nid not in p["replicas"]
+                ]
+                if not candidates:
+                    continue
+                # least-loaded placement (reference: anti-affinity by
+                # node; fewest partitions wins)
+                target = min(candidates,
+                             key=lambda s: len(s.partition_ids))
+                if self._add_replica(sp, p, target, servers):
+                    changed = True
             if changed:
                 self.store.put(key, sp)
+
+    def _add_replica(self, sp: dict, p: dict, target, servers) -> bool:
+        """Create the partition on `target` as a follower and decree the
+        widened membership; the leader's next tick catches it up by log
+        replay or snapshot (reference: recover via raft snapshot)."""
+        new_term = int(p.get("term", 1)) + 1
+        members = sorted(set(p["replicas"]) | {target.node_id})
+        part = dict(p)
+        part["replicas"] = members
+        part["term"] = new_term
+        try:
+            rpc.call(target.rpc_addr, "POST", "/ps/partition/create", {
+                "partition": part,
+                "schema": sp["schema"],
+            })
+        except RpcError as e:
+            if e.code != 409:  # already hosted: continue with membership
+                return False
+        p["replicas"] = members
+        p["term"] = new_term
+        ok = True
+        for r in members:
+            srv = servers.get(r)
+            if srv is None:
+                continue
+            path = "/ps/raft/lead" if r == p["leader"] else "/ps/raft/members"
+            try:
+                rpc.call(srv.rpc_addr, "POST", path,
+                         {"pid": p["id"], "term": new_term,
+                          "members": members, "leader": p["leader"]})
+            except RpcError:
+                ok = ok and r != p["leader"]
+        if p["id"] not in target.partition_ids:
+            target.partition_ids.append(p["id"])
+            self.store.put(f"{PREFIX_SERVER}{target.node_id}",
+                           target.to_dict())
+        return ok
+
+    def _h_change_member(self, body: dict, _parts) -> dict:
+        """Manual membership admin (reference: /partitions/change_member,
+        cluster_api.go:309-319; method 0=add, 1=remove)."""
+        pid = int(body["partition_id"])
+        node_id = int(body["node_id"])
+        method = body.get("method", "add")
+        servers = {s.node_id: s for s in self._alive_servers()}
+        with self._reconfig_lock:
+            return self._change_member_locked(pid, node_id, method, servers)
+
+    def _change_member_locked(self, pid, node_id, method, servers) -> dict:
+        for key, sp in self.store.prefix(PREFIX_SPACE).items():
+            for p in sp["partitions"]:
+                if p["id"] != pid:
+                    continue
+                if method in ("add", 0):
+                    srv = servers.get(node_id)
+                    if srv is None:
+                        raise RpcError(404, f"node {node_id} not alive")
+                    if not self._add_replica(sp, p, srv, servers):
+                        raise RpcError(503, "add_member failed")
+                else:
+                    if node_id not in p["replicas"]:
+                        raise RpcError(404,
+                                       f"node {node_id} not a replica")
+                    if not self._reconfigure_partition(p, servers,
+                                                       drop=node_id):
+                        raise RpcError(503, "remove_member failed")
+                    srv = servers.get(node_id)
+                    if srv is not None:
+                        try:
+                            rpc.call(srv.rpc_addr, "POST",
+                                     "/ps/partition/delete",
+                                     {"partition_id": pid})
+                        except RpcError:
+                            pass
+                self.store.put(key, sp)
+                return {"partition": p}
+        raise RpcError(404, f"partition {pid} not found")
 
     # -- users / roles (reference: cluster_api.go user/role admin) -----------
 
@@ -391,14 +601,24 @@ class MasterServer:
             results = []
             for i, part in enumerate(sorted(space.partitions,
                                             key=lambda p: p.slot)):
-                srv = servers.get(part.leader)
-                if srv is None:
+                if servers.get(part.leader) is None:
                     raise RpcError(503, f"leader of partition {part.id} down")
-                results.append(rpc.call(srv.rpc_addr, "POST", "/ps/restore", {
-                    "partition_id": part.id,
-                    "store_root": store_root,
-                    "key_prefix": f"{prefix}/shard_{i}",
-                }))
+                # restore is a point-in-time rewind: every replica resets
+                # to the backup state (each clears its own log), or the
+                # followers would silently keep the pre-restore data
+                out = None
+                for r in part.replicas:
+                    srv = servers.get(r)
+                    if srv is None:
+                        continue
+                    res = rpc.call(srv.rpc_addr, "POST", "/ps/restore", {
+                        "partition_id": part.id,
+                        "store_root": store_root,
+                        "key_prefix": f"{prefix}/shard_{i}",
+                    })
+                    if r == part.leader:
+                        out = res
+                results.append(out)
             return {"version": version, "partitions": results}
 
         raise RpcError(400, f"unknown backup command {command!r}")

@@ -1,16 +1,23 @@
-"""Partition server (data plane): hosts one Engine per partition.
+"""Partition server (data plane): hosts one Engine + RaftNode per partition.
 
 TPU-native re-design of the reference's PS role (reference:
-internal/ps/server.go:76 lifecycle + partition registry sync.Map;
+internal/ps/server.go:76 lifecycle + partition registry;
 handler_document.go:64 data RPC; handler_admin.go:90 admin RPC;
-partition_service.go:154 create/recover). Raft replication slots in at
-this layer in a later round (replica_num=1 paths are complete); the
-handler surface already mirrors the reference's admin/data split.
+partition_service.go:154 create/recover). Every write flows through a
+per-partition replicated log (cluster/raft.py — the analogue of
+raftstore/store_writer.go:77): WAL fsync + quorum ack before the client
+ack, follower apply from the log, snapshot catch-up for laggards. A
+periodic flush job checkpoints the engine with its applied index and
+truncates the log behind it (reference: store_raft_job.go:97,40).
 """
 
 from __future__ import annotations
 
+import io
+import json
 import os
+import shutil
+import tarfile
 import threading
 import time
 from typing import Any
@@ -19,7 +26,13 @@ from vearch_tpu.engine.engine import Engine, SearchRequest
 from vearch_tpu.engine.types import TableSchema
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Partition
+from vearch_tpu.cluster.raft import RaftNode
 from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+
+# log entries retained behind the flushed/applied horizon so a briefly
+# lagging follower catches up by replay instead of full snapshot
+# (reference: raft_truncate_count)
+WAL_KEEP_ENTRIES = 10_000
 
 
 class PSServer:
@@ -34,11 +47,19 @@ class PSServer:
         memory_limit_mb: int = 0,
         master_auth: tuple[str, str] | None = None,
         backup_roots: list[str] | None = None,
+        flush_interval: float = 5.0,
+        raft_tick: float = 0.4,
     ):
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.engines: dict[int, Engine] = {}
         self.partitions: dict[int, Partition] = {}
+        self.raft_nodes: dict[int, RaftNode] = {}
+        self._flushed: dict[int, int] = {}  # pid -> applied idx at last flush
+        # one checkpoint at a time per partition: concurrent flushes
+        # (flush loop + /ps/flush + snapshot sends) would interleave
+        # writes to the same snapshot files
+        self._flush_locks: dict[int, threading.Lock] = {}
         self._lock = threading.Lock()
         self.master_addr = master_addr
         # service credentials for master calls when the cluster runs with
@@ -46,6 +67,8 @@ class PSServer:
         self.master_auth = master_auth
         self.node_id: int | None = None
         self.heartbeat_interval = heartbeat_interval
+        self.flush_interval = flush_interval
+        self.raft_tick = raft_tick
         self._stop = threading.Event()
         # concurrency gate (reference: RequestConcurrentController,
         # search/engine.h:197; rpcx request concurrency, ps/server.go:89)
@@ -62,6 +85,7 @@ class PSServer:
             else None
         )
         self.replication_errors = 0  # surfaced in /ps/stats
+        self._peer_cache: tuple[float, dict[int, str]] = (0.0, {})
 
         self.server = JsonRpcServer(host, port)
         s = self.server
@@ -79,19 +103,36 @@ class PSServer:
         s.route("POST", "/ps/backup", self._h_backup)
         s.route("POST", "/ps/restore", self._h_restore)
         s.route("GET", "/ps/stats", self._h_stats)
+        # raft transport (reference: raftstore/server.go heartbeat +
+        # replicate ports; here routes on the one RPC server)
+        s.route("POST", "/ps/raft/append", self._h_raft_append)
+        s.route("POST", "/ps/raft/fence", self._h_raft_fence)
+        s.route("POST", "/ps/raft/lead", self._h_raft_lead)
+        s.route("POST", "/ps/raft/members", self._h_raft_members)
+        s.route("POST", "/ps/raft/snapshot", self._h_raft_snapshot)
+        s.route("GET", "/ps/raft/state", self._h_raft_state)
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         self.server.start()
-        self._recover_partitions()
         if self.master_addr:
             self._register()
-            t = threading.Thread(target=self._heartbeat_loop, daemon=True)
-            t.start()
+        self._recover_partitions()
+        if self.master_addr:
+            threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        threading.Thread(target=self._flush_loop, daemon=True).start()
+        threading.Thread(target=self._raft_tick_loop, daemon=True).start()
 
-    def stop(self) -> None:
+    def stop(self, flush: bool = True) -> None:
         self._stop.set()
+        for pid in list(self.raft_nodes):
+            if flush:
+                try:
+                    self.flush_partition(pid)
+                except Exception:
+                    pass
+            self.raft_nodes[pid].close()
         for eng in self.engines.values():
             eng.close()
         self.server.stop()
@@ -102,7 +143,14 @@ class PSServer:
 
     def _register(self) -> None:
         """Register with the master, retrying forever (reference:
-        ps/server.go:228 lease-backed registration)."""
+        ps/server.go:228 lease-backed registration). Node identity is
+        persisted locally so a restarted PS keeps its node_id — the
+        partitions on disk are addressed by it (reference:
+        ps/psutil/meta.go:40 InitMeta local meta file)."""
+        meta_path = os.path.join(self.data_dir, "node_meta.json")
+        if self.node_id is None and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                self.node_id = int(json.load(f)["node_id"])
         while not self._stop.is_set():
             try:
                 data = rpc.call(
@@ -111,6 +159,10 @@ class PSServer:
                     auth=self.master_auth,
                 )
                 self.node_id = data["node_id"]
+                tmp = meta_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"node_id": self.node_id}, f)
+                os.replace(tmp, meta_path)
                 return
             except RpcError:
                 time.sleep(0.5)
@@ -127,19 +179,260 @@ class PSServer:
             except RpcError:
                 pass
 
+    # -- recovery (reference: partition_service.go:275 recoverPartitions:
+    #    re-Build engine, gamma Load, rejoin raft) ---------------------------
+
     def _recover_partitions(self) -> None:
-        """Reload engines dumped under data_dir (reference:
-        partition_service.go:275 recoverPartitions)."""
-        for name in os.listdir(self.data_dir):
-            p = os.path.join(self.data_dir, name)
-            if name.startswith("partition_") and os.path.isdir(p):
-                pid = int(name.split("_")[1])
-                try:
-                    eng = Engine.open(p)
-                    eng.start_refresh_loop()
+        # the master's metadata wins over the locally persisted
+        # partition.json: leadership may have moved while we were down
+        current: dict[int, dict] = {}
+        if self.master_addr:
+            try:
+                for p in rpc.call(self.master_addr, "GET", "/partitions",
+                                  auth=self.master_auth)["partitions"]:
+                    current[int(p["id"])] = p
+            except RpcError:
+                pass
+        for name in sorted(os.listdir(self.data_dir)):
+            pdir = os.path.join(self.data_dir, name)
+            if not (name.startswith("partition_") and os.path.isdir(pdir)):
+                continue
+            pid = int(name.split("_")[1])
+            try:
+                with open(os.path.join(pdir, "partition.json")) as f:
+                    part = Partition.from_dict(json.load(f))
+                if pid in current:
+                    part = Partition.from_dict(current[pid])
+                    self._persist_partition_meta(part)
+                eng = Engine.open(pdir)
+                eng.start_refresh_loop()
+                applied = 0
+                ap = os.path.join(pdir, "applied.json")
+                if os.path.exists(ap):
+                    with open(ap) as f:
+                        applied = int(json.load(f)["applied"])
+                node = self._make_raft_node(part, pdir)
+                node.applied = applied
+                self._flushed[pid] = applied
+                with self._lock:
                     self.engines[pid] = eng
-                except Exception:
+                    self.partitions[pid] = part
+                    self.raft_nodes[pid] = node
+                # replay the committed tail into the engine; single-
+                # member groups treat every fsync'd entry as committed
+                node.recover_singleton_commit()
+                node._apply_to_commit()
+            except Exception as e:
+                import sys
+
+                print(f"[ps {self.node_id}] recover partition {pid} "
+                      f"failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+
+    # -- raft plumbing -------------------------------------------------------
+
+    def _make_raft_node(self, part: Partition, pdir: str) -> RaftNode:
+        pid = part.id
+        members = part.replicas or [self.node_id or 0]
+        return RaftNode(
+            pid=pid,
+            node_id=self.node_id if self.node_id is not None else 0,
+            wal_dir=os.path.join(pdir, "raft"),
+            apply_fn=lambda op, _pid=pid: self._apply(_pid, op),
+            send_fn=self._raft_send,
+            members=members,
+            # leader iff the metadata says so, or this node is the sole
+            # member (a directly-created local partition). A node NOT in
+            # the member list (e.g. removed while down) is never leader.
+            is_leader=(part.leader == self.node_id
+                       or members == [self.node_id]),
+            snapshot_fn=lambda _pid=pid: self._take_snapshot(_pid),
+            install_fn=lambda data, idx, _pid=pid: self._install_snapshot(
+                _pid, data, idx),
+        )
+
+    def _apply(self, pid: int, op: dict) -> Any:
+        """State-machine apply (reference: raft_state_machine.go:124
+        innerApply -> gammacb writer). Deterministic: every replica
+        applies identical ops in identical log order."""
+        eng = self._engine(pid)
+        t = op["type"]
+        if t == "upsert":
+            return eng.upsert(op["documents"])
+        if t == "delete":
+            return eng.delete(op["keys"])
+        raise RpcError(500, f"unknown log op {t!r}")
+
+    def _peer_addr(self, peer: int) -> str:
+        now = time.time()
+        ts, cache = self._peer_cache
+        if now - ts > 2.0 or peer not in cache:
+            servers = rpc.call(self.master_addr, "GET", "/servers",
+                               auth=self.master_auth)["servers"]
+            cache = {s["node_id"]: s["rpc_addr"] for s in servers}
+            self._peer_cache = (now, cache)
+        if peer not in cache:
+            raise RpcError(503, f"no address for node {peer}")
+        return cache[peer]
+
+    def _raft_send(self, peer: int, path: str, body: dict) -> dict:
+        try:
+            return rpc.call(self._peer_addr(peer), "POST", path, body,
+                            timeout=30.0)
+        except RpcError:
+            self.replication_errors += 1
+            raise
+
+    def _node(self, pid: int) -> RaftNode:
+        node = self.raft_nodes.get(int(pid))
+        if node is None:
+            raise RpcError(404, f"partition {pid} not on this node")
+        return node
+
+    def _h_raft_append(self, body: dict, _parts) -> dict:
+        return self._node(body["pid"]).handle_append(body)
+
+    def _h_raft_fence(self, body: dict, _parts) -> dict:
+        return self._node(body["pid"]).handle_fence(int(body["term"]))
+
+    def _h_raft_lead(self, body: dict, _parts) -> dict:
+        pid = int(body["pid"])
+        node = self._node(pid)
+        out = node.become_leader(int(body["term"]), body["members"])
+        self._update_partition_meta(pid, leader=self.node_id,
+                                    term=int(body["term"]),
+                                    replicas=body["members"])
+        return out
+
+    def _h_raft_members(self, body: dict, _parts) -> dict:
+        pid = int(body["pid"])
+        node = self._node(pid)
+        out = node.set_members(int(body["term"]), body["members"])
+        self._update_partition_meta(pid, term=int(body["term"]),
+                                    replicas=body["members"],
+                                    leader=body.get("leader"))
+        return out
+
+    def _h_raft_snapshot(self, body: dict, _parts) -> dict:
+        return self._node(body["pid"]).handle_install_snapshot(body)
+
+    def _h_raft_state(self, body, parts) -> dict:
+        if parts:
+            return self._node(int(parts[0])).state()
+        return {str(pid): n.state() for pid, n in self.raft_nodes.items()}
+
+    def _update_partition_meta(self, pid: int, leader=None, term=None,
+                               replicas=None) -> None:
+        part = self.partitions.get(pid)
+        if part is None:
+            return
+        if leader is not None:
+            part.leader = leader
+        if term is not None:
+            part.term = term
+        if replicas is not None:
+            part.replicas = list(replicas)
+        self._persist_partition_meta(part)
+
+    def _persist_partition_meta(self, part: Partition) -> None:
+        pdir = os.path.join(self.data_dir, f"partition_{part.id}")
+        os.makedirs(pdir, exist_ok=True)
+        tmp = os.path.join(pdir, "partition.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(part.to_dict(), f)
+        os.replace(tmp, os.path.join(pdir, "partition.json"))
+
+    # -- flush job (reference: store_raft_job.go:97 flush job records the
+    #    applied SN; :40 truncate job trims the log behind it) --------------
+
+    def _flush_loop(self) -> None:
+        import sys
+
+        while not self._stop.is_set():
+            time.sleep(self.flush_interval)
+            for pid in list(self.raft_nodes):
+                try:
+                    node = self.raft_nodes.get(pid)
+                    if node is None:
+                        continue
+                    if node.applied > self._flushed.get(pid, 0):
+                        self.flush_partition(pid)
+                except Exception as e:
+                    # a silently failing flush would stop checkpointing
+                    # AND WAL truncation — always loud
+                    print(f"[ps {self.node_id}] flush partition {pid} "
+                          f"failed: {type(e).__name__}: {e}",
+                          file=sys.stderr, flush=True)
+
+    def flush_partition(self, pid: int) -> int:
+        """Checkpoint the engine with its applied index, then truncate
+        the WAL behind it (keeping a catch-up tail). Returns the flushed
+        applied index."""
+        node = self._node(pid)
+        eng = self._engine(pid)
+        pdir = os.path.join(self.data_dir, f"partition_{pid}")
+        with self._flush_locks.setdefault(pid, threading.Lock()):
+            # capture under the apply mutex so the engine snapshot
+            # matches node.applied exactly; disk writes happen outside
+            # it (but inside the flush lock — one checkpoint at a time)
+            with node._apply_lock:
+                applied = node.applied
+                snap = eng.snapshot_state()
+            eng.write_snapshot(snap, pdir)
+            tmp = os.path.join(pdir, "applied.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"applied": applied, "term": node.term}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(pdir, "applied.json"))
+            self._flushed[pid] = applied
+            node.wal.save_meta(fsync=True)
+            node.wal.truncate_prefix(
+                max(node.wal.first_index, applied - WAL_KEEP_ENTRIES + 1)
+            )
+        return applied
+
+    def _raft_tick_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.raft_tick)
+            for node in list(self.raft_nodes.values()):
+                if node.is_leader and len(node.members) > 1:
+                    node.tick()
+
+    # -- snapshot transfer (reference: gammacb/snapshot.go:26 streams the
+    #    engine's on-disk files in chunks) ----------------------------------
+
+    def _take_snapshot(self, pid: int) -> tuple[bytes, int]:
+        applied = self.flush_partition(pid)
+        pdir = os.path.join(self.data_dir, f"partition_{pid}")
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            for name in sorted(os.listdir(pdir)):
+                # raft log + local membership are per-replica, not state
+                if name in ("raft", "partition.json") or \
+                        name.endswith(".tmp"):
                     continue
+                tar.add(os.path.join(pdir, name), arcname=name)
+        return buf.getvalue(), applied
+
+    def _install_snapshot(self, pid: int, data: bytes, snap_index: int
+                          ) -> None:
+        pdir = os.path.join(self.data_dir, f"partition_{pid}")
+        old = self.engines.get(pid)
+        if old is not None:
+            old.close()
+        for name in list(os.listdir(pdir)):
+            if name in ("raft", "partition.json"):
+                continue
+            p = os.path.join(pdir, name)
+            shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+            tar.extractall(pdir, filter="data")
+        eng = Engine.open(pdir)
+        eng.start_refresh_loop()
+        with self._lock:
+            self.engines[pid] = eng
+        self._flushed[pid] = snap_index
 
     # -- handlers ------------------------------------------------------------
 
@@ -150,79 +443,49 @@ class PSServer:
         return eng
 
     def _h_create_partition(self, body: dict, _parts) -> dict:
-        pid = int(body["partition"]["id"])
+        part = Partition.from_dict(body["partition"])
+        pid = part.id
         with self._lock:
             if pid in self.engines:
                 raise RpcError(409, f"partition {pid} already exists")
             schema = TableSchema.from_dict(body["schema"])
-            data_dir = os.path.join(self.data_dir, f"partition_{pid}")
-            eng = Engine(schema, data_dir=data_dir)
+            pdir = os.path.join(self.data_dir, f"partition_{pid}")
+            eng = Engine(schema, data_dir=pdir)
+            eng.dump()  # schema on disk immediately: crash-openable
             eng.start_refresh_loop()
             self.engines[pid] = eng
-            self.partitions[pid] = Partition.from_dict(body["partition"])
+            self.partitions[pid] = part
+            self._persist_partition_meta(part)
+            node = self._make_raft_node(part, pdir)
+            if part.term > node.wal.term:
+                node.wal.term = part.term
+                node.wal.save_meta()
+            self.raft_nodes[pid] = node
         return {"partition_id": pid}
 
     def _h_delete_partition(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])
         with self._lock:
-            self.engines.pop(pid, None)
+            node = self.raft_nodes.pop(pid, None)
+            if node is not None:
+                node.close()
+            eng = self.engines.pop(pid, None)
+            if eng is not None:
+                eng.close()
             self.partitions.pop(pid, None)
-        import shutil
-
+            self._flushed.pop(pid, None)
         shutil.rmtree(
             os.path.join(self.data_dir, f"partition_{pid}"), ignore_errors=True
         )
         return {"partition_id": pid}
 
-    # -- replication v0 (primary-backup) -------------------------------------
-    # The leader applies a write locally, then forwards it synchronously to
-    # every follower replica before acking (the reference replicates through
-    # a raft log, raftstore/store_writer.go:77; a log-structured raft sits
-    # here in a later round — the fan-out seam is identical).
-
-    def _peer_addrs(self, pid: int) -> list[str]:
-        part = self.partitions.get(pid)
-        if part is None or self.master_addr is None:
-            return []
-        if part.leader != self.node_id:
-            return []
-        peers = [r for r in part.replicas if r != self.node_id]
-        if not peers:
-            return []
-        try:
-            servers = rpc.call(self.master_addr, "GET", "/servers",
-                               auth=self.master_auth)["servers"]
-        except RpcError:
-            return []
-        by_id = {s["node_id"]: s["rpc_addr"] for s in servers}
-        return [by_id[p] for p in peers if p in by_id]
-
-    def _replicate(self, pid: int, path: str, body: dict) -> None:
-        import sys
-
-        peers = self._peer_addrs(pid)
-        part = self.partitions.get(pid)
-        if not peers and part is not None and part.leader == self.node_id \
-                and len(part.replicas) > 1:
-            # replicas exist but none reachable/resolvable: never silent —
-            # this exact silence hid an auth misconfiguration once
-            self.replication_errors += 1
-            if self.replication_errors == 1:
-                print(f"[ps {self.node_id}] WARNING: partition {pid} has "
-                      f"replicas {part.replicas} but peer resolution "
-                      f"returned none; followers are going stale",
-                      file=sys.stderr, flush=True)
-        for addr in peers:
-            try:
-                rpc.call(addr, "POST", path, {**body, "replicated": True})
-            except RpcError as e:
-                self.replication_errors += 1
-                print(f"[ps {self.node_id}] replication to {addr} failed: "
-                      f"{e.msg[:80]}", file=sys.stderr, flush=True)
+    # -- writes: every mutation is a log proposal ---------------------------
 
     def _h_upsert(self, body: dict, _parts) -> dict:
+        import uuid
+
         pid = int(body["partition_id"])
-        eng = self._engine(pid)
+        self._engine(pid)  # 404 before proposing
         if self.memory_limit_mb:
             used = sum(
                 e.memory_usage_bytes() for e in self.engines.values()
@@ -234,21 +497,27 @@ class PSServer:
                     f"limit {self.memory_limit_mb}MB (writes rejected, "
                     f"reads still served)",
                 )
-        keys = eng.upsert(body["documents"])
-        if not body.get("replicated"):
-            self._replicate(pid, "/ps/doc/upsert",
-                            {"partition_id": pid,
-                             "documents": body["documents"]})
+        # assign ids BEFORE the log so replicas apply identical ops.
+        # NOTE on retries: propose may 503 while the entry later commits
+        # (at-least-once); a retry is safe because the router assigns
+        # _ids before fan-out, so the replayed upsert is an idempotent
+        # update. Direct-PS callers should pass _id themselves — the
+        # uuid fallback here makes a blind retry mint a second document.
+        docs = [
+            doc if "_id" in doc else {**doc, "_id": uuid.uuid4().hex}
+            for doc in body["documents"]
+        ]
+        keys = self._node(pid).propose([{"type": "upsert",
+                                         "documents": docs}])[0]
         return {"keys": keys, "count": len(keys)}
 
     def _h_delete(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])
         eng = self._engine(pid)
+        node = self._node(pid)
         if body.get("keys"):
-            deleted = eng.delete(body["keys"])
-            if not body.get("replicated"):
-                self._replicate(pid, "/ps/doc/delete",
-                                {"partition_id": pid, "keys": body["keys"]})
+            deleted = node.propose([{"type": "delete",
+                                     "keys": body["keys"]}])[0]
             return {"deleted": deleted}
         # delete-by-filter (reference: /document/delete with filters).
         # Drain in batches until no matches remain — a single capped
@@ -267,10 +536,7 @@ class PSServer:
             if not docs:
                 break
             keys = [d["_id"] for d in docs]
-            deleted += eng.delete(keys)
-            if not body.get("replicated"):
-                self._replicate(pid, "/ps/doc/delete",
-                                {"partition_id": pid, "keys": keys})
+            deleted += node.propose([{"type": "delete", "keys": keys}])[0]
             if len(docs) < want:
                 break
         return {"deleted": deleted}
@@ -349,9 +615,10 @@ class PSServer:
         return {"status": int(eng.status)}
 
     def _h_flush(self, body: dict, _parts) -> dict:
-        eng = self._engine(body["partition_id"])
-        eng.dump()
-        return {"doc_count": eng.doc_count}
+        pid = int(body["partition_id"])
+        applied = self.flush_partition(pid)
+        return {"doc_count": self._engine(pid).doc_count,
+                "applied": applied}
 
     def _h_engine_config(self, body: dict, _parts) -> dict:
         cfg = body.get("config") or {}
@@ -389,22 +656,32 @@ class PSServer:
         return {"partition_id": pid, "files": n}
 
     def _h_restore(self, body: dict, _parts) -> dict:
-        import shutil
-
         from vearch_tpu.cluster.objectstore import LocalObjectStore
 
         pid = int(body["partition_id"])
         eng = self._engine(pid)  # partition must exist (space created first)
+        node = self._node(pid)
         self._check_backup_root(body["store_root"])
         store = LocalObjectStore(body["store_root"])
         data_dir = os.path.join(self.data_dir, f"partition_{pid}")
-        shutil.rmtree(data_dir, ignore_errors=True)
-        n = store.get_tree(body["key_prefix"], data_dir)
-        eng.close()
-        restored = Engine.open(data_dir)
-        restored.start_refresh_loop()
-        with self._lock:
-            self.engines[pid] = restored
+        with node._apply_lock:
+            eng.close()
+            for name in list(os.listdir(data_dir)):
+                if name in ("raft", "partition.json"):
+                    continue
+                p = os.path.join(data_dir, name)
+                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+            n = store.get_tree(body["key_prefix"], data_dir)
+            restored = Engine.open(data_dir)
+            restored.start_refresh_loop()
+            with self._lock:
+                self.engines[pid] = restored
+            # restored state supersedes the log: reset it at the current
+            # applied horizon (a restore is a point-in-time rewind)
+            node.wal.reset(node.wal.last_index + 1)
+            node.applied = node.wal.last_index
+            node.wal.commit_index = node.wal.last_index
+            node.wal.save_meta(fsync=True)
         return {"partition_id": pid, "files": n,
                 "doc_count": restored.doc_count}
 
@@ -417,6 +694,8 @@ class PSServer:
                     "doc_count": eng.doc_count,
                     "status": int(eng.status),
                     "memory_bytes": eng.memory_usage_bytes(),
+                    "raft": self.raft_nodes[pid].state()
+                    if pid in self.raft_nodes else None,
                 }
                 for pid, eng in self.engines.items()
             },

@@ -1,0 +1,488 @@
+"""Per-partition replicated log (leader/follower state machine).
+
+TPU-native analogue of the reference's raftstore (reference:
+internal/ps/storage/raftstore/store.go:70 CreateStore,
+store_writer.go:77 quorum write proposals, raft_state_machine.go:92
+Apply on every replica, gammacb/snapshot.go:26 snapshot-as-file-stream).
+
+Design differences from textbook raft, on purpose:
+- **Leadership is master-arbitrated, not voted.** The metadata plane
+  (master) is the single config authority, like the reference's etcd.
+  Promotion is fencing-based: the master bumps the partition term on
+  every alive replica FIRST (after which stale-term appends are
+  rejected, so a deposed leader can no longer commit), then appoints
+  the replica with the max (last_term, last_index) log. This trades
+  raft's partition-tolerant election for a simpler protocol with the
+  same no-acked-write-lost guarantee under fail-stop failures.
+- **Commit is count-based across terms.** Safe here because fencing
+  guarantees no older-term leader can assemble a quorum after a
+  promotion.
+- Membership changes are master-decreed (reference: ChangeMember RPC,
+  ps/handler_admin.go:329) and fence through a term bump.
+
+Everything else is the classic algorithm: append-only WAL, quorum ack
+before the client ack, follower conflict truncation, next_index backoff
+catch-up, log-compaction behind flush with snapshot install for
+followers that fell behind the truncation horizon.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from typing import Any, Callable
+
+from vearch_tpu.cluster.rpc import RpcError
+from vearch_tpu.cluster.wal import Wal
+
+SNAP_CHUNK = 4 << 20  # 4 MB per snapshot chunk (reference streams 10MB)
+
+
+class RaftNode:
+    """One replica of one partition's replicated log."""
+
+    def __init__(
+        self,
+        pid: int,
+        node_id: int,
+        wal_dir: str,
+        apply_fn: Callable[[dict], Any],
+        send_fn: Callable[[int, str, dict], dict],
+        members: list[int],
+        is_leader: bool,
+        snapshot_fn: Callable[[], tuple[bytes, int]] | None = None,
+        install_fn: Callable[[bytes, int], None] | None = None,
+        quorum_timeout: float = 10.0,
+    ):
+        self.pid = pid
+        self.node_id = node_id
+        self.wal = Wal(wal_dir)
+        self.apply_fn = apply_fn
+        self.send_fn = send_fn
+        self.snapshot_fn = snapshot_fn
+        self.install_fn = install_fn
+        self.quorum_timeout = quorum_timeout
+
+        self.members = list(members) if members else [node_id]
+        self.is_leader = bool(is_leader)
+        self.applied = 0  # set by recovery before serving
+        self._apply_results: dict[int, Any] = {}
+
+        self._lock = threading.RLock()  # protects term/commit/log decisions
+        self._apply_lock = threading.Lock()  # serialises state-machine applies
+        self._propose_lock = threading.Lock()  # one in-flight proposal batch
+        self._peer_locks: dict[int, threading.Lock] = {}
+        self._match: dict[int, int] = {}  # peer -> highest replicated index
+        self._next: dict[int, int] = {}  # peer -> next index to send
+        self._commit_cv = threading.Condition(self._lock)
+        self._stopped = False
+
+        # incoming snapshot staging: sid -> {chunks, snap_index, term}
+        self._snap_in: dict[str, dict] = {}
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def term(self) -> int:
+        return self.wal.term
+
+    @property
+    def commit(self) -> int:
+        return self.wal.commit_index
+
+    def quorum(self) -> int:
+        return len(self.members) // 2 + 1
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "pid": self.pid,
+                "node_id": self.node_id,
+                "term": self.term,
+                "last_index": self.wal.last_index,
+                "last_term": self.wal.last_term,
+                "commit": self.commit,
+                "applied": self.applied,
+                "is_leader": self.is_leader,
+                "members": list(self.members),
+            }
+
+    # -- leader: propose + replicate -----------------------------------------
+
+    def propose(self, ops: list[dict]) -> list[Any]:
+        """Append ops, replicate to a quorum, commit, apply. Returns the
+        apply results in op order. Raises 421 when not leader, 503 when
+        a quorum cannot be assembled in time (the entries stay in the
+        log and may commit later — at-least-once, ops are idempotent)."""
+        with self._propose_lock:
+            with self._lock:
+                if not self.is_leader:
+                    raise RpcError(421, f"partition {self.pid}: not leader")
+                term = self.term
+                start = self.wal.last_index + 1
+                entries = [
+                    {"index": start + i, "term": term, "op": op}
+                    for i, op in enumerate(ops)
+                ]
+                self.wal.append(entries, fsync=True)
+                target = entries[-1]["index"]
+            self._replicate_and_wait(target)
+            with self._lock:
+                if self.commit < target:
+                    raise RpcError(
+                        503,
+                        f"partition {self.pid}: no quorum for index "
+                        f"{target} within {self.quorum_timeout}s",
+                    )
+            self._apply_to_commit()
+            # push the advanced commit index to followers synchronously
+            # so they apply before the client sees the ack — follower
+            # reads (load_balance random/not_leader) then serve the
+            # write immediately, matching the reference's synchronous
+            # replica visibility expectations. Best-effort: a straggler
+            # catches up on the next tick.
+            self._notify_commit()
+            with self._lock:
+                return [self._apply_results[e["index"]] for e in entries]
+
+    def _replicate_and_wait(self, target: int) -> None:
+        peers = [m for m in self.members if m != self.node_id]
+        if not peers:  # single-replica group: commit == append
+            self._advance_commit()
+            return
+        for p in peers:
+            t = threading.Thread(
+                target=self._sync_peer, args=(p,), daemon=True
+            )
+            t.start()
+        deadline = time.time() + self.quorum_timeout
+        with self._commit_cv:
+            while self.commit < target and time.time() < deadline:
+                self._commit_cv.wait(timeout=0.05)
+
+    def _sync_peer(self, peer: int, blocking: bool = False) -> None:
+        """Bring one follower up to date (serialised per peer: append
+        order to a given follower must be monotonic)."""
+        lock = self._peer_locks.setdefault(peer, threading.Lock())
+        if not lock.acquire(blocking=blocking):
+            return  # a sync to this peer is already running
+        try:
+            self._sync_peer_locked(peer)
+        finally:
+            lock.release()
+
+    def _notify_commit(self) -> None:
+        peers = [m for m in self.members if m != self.node_id]
+        threads = [
+            threading.Thread(target=self._sync_peer, args=(p, True),
+                             daemon=True)
+            for p in peers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+
+    def _sync_peer_locked(self, peer: int) -> None:
+        backoff_probes = 0
+        while not self._stopped:
+            with self._lock:
+                if not self.is_leader:
+                    return
+                term = self.term
+                ni = self._next.get(peer, self.wal.last_index + 1)
+                prev = ni - 1
+                prev_term = self.wal.term_at(prev)
+                commit = self.commit
+                entries = self.wal.entries_from(ni) if prev_term is not None \
+                    else []
+            if prev_term is None:
+                # the entry before next_index was compacted away: the
+                # follower is behind the log horizon -> full snapshot
+                # (reference: gammacb/snapshot.go file stream)
+                if not self._send_snapshot(peer, term):
+                    return
+                continue
+            try:
+                resp = self.send_fn(peer, "/ps/raft/append", {
+                    "pid": self.pid, "term": term, "leader": self.node_id,
+                    "prev_index": prev, "prev_term": prev_term,
+                    "entries": entries, "commit": commit,
+                })
+            except RpcError:
+                return  # peer unreachable; next tick retries
+            with self._lock:
+                if resp.get("term", 0) > self.term:
+                    self._step_down(resp["term"])
+                    return
+                if resp.get("success"):
+                    sent_last = entries[-1]["index"] if entries else prev
+                    self._match[peer] = max(
+                        self._match.get(peer, 0), sent_last
+                    )
+                    self._next[peer] = sent_last + 1
+                    self._advance_commit()
+                    if self._next[peer] > self.wal.last_index:
+                        return
+                else:
+                    # follower nack: jump next_index to its log end + 1
+                    hint = int(resp.get("last_index", prev - 1))
+                    self._next[peer] = min(max(hint + 1, 1), prev)
+                    backoff_probes += 1
+                    if backoff_probes > 10_000:
+                        return
+
+    def _advance_commit(self) -> None:
+        with self._lock:
+            if not self.is_leader:
+                return
+            indices = sorted(
+                [self.wal.last_index]
+                + [self._match.get(p, 0)
+                   for p in self.members if p != self.node_id],
+                reverse=True,
+            )
+            candidate = indices[self.quorum() - 1]
+            if candidate > self.commit:
+                self.wal.commit_index = candidate
+                self.wal.save_meta()
+                self._commit_cv.notify_all()
+
+    def _send_snapshot(self, peer: int, term: int) -> bool:
+        if self.snapshot_fn is None:
+            return False
+        data, snap_index = self.snapshot_fn()
+        sid = f"{self.node_id}-{time.time_ns()}"
+        try:
+            for off in range(0, max(len(data), 1), SNAP_CHUNK):
+                chunk = data[off : off + SNAP_CHUNK]
+                resp = self.send_fn(peer, "/ps/raft/snapshot", {
+                    "pid": self.pid, "term": term, "sid": sid,
+                    "snap_index": snap_index,
+                    "off": off, "total": len(data),
+                    "data": base64.b64encode(chunk).decode(),
+                    "done": off + SNAP_CHUNK >= len(data),
+                })
+                if not resp.get("success"):
+                    return False
+        except RpcError:
+            return False
+        with self._lock:
+            self._match[peer] = max(self._match.get(peer, 0), snap_index)
+            self._next[peer] = snap_index + 1
+            self._advance_commit()
+        return True
+
+    def tick(self) -> None:
+        """Leader heartbeat: push commit index and catch up any lagging
+        follower (reference: raft heartbeat + replicate transport)."""
+        with self._lock:
+            if not self.is_leader or self._stopped:
+                return
+            peers = [m for m in self.members if m != self.node_id]
+        for p in peers:
+            threading.Thread(
+                target=self._sync_peer, args=(p,), daemon=True
+            ).start()
+
+    # -- apply ---------------------------------------------------------------
+
+    def _apply_to_commit(self) -> dict[int, Any]:
+        """Apply committed-but-unapplied entries in index order. Returns
+        {index: result} for entries applied by this call."""
+        out: dict[int, Any] = {}
+        with self._apply_lock:
+            while True:
+                with self._lock:
+                    nxt = self.applied + 1
+                    if nxt > self.commit:
+                        break
+                    e = self.wal.get(nxt)
+                if e is None:
+                    break  # compacted (snapshot already covers it)
+                result = self.apply_fn(e["op"])
+                out[nxt] = result
+                with self._lock:
+                    self.applied = nxt
+                    # keep a bounded window of recent results: a propose
+                    # whose entries were applied by a concurrent path
+                    # (master decree, follower append) still needs them
+                    self._apply_results[nxt] = result
+                    stale = nxt - 4096
+                    if stale in self._apply_results:
+                        self._apply_results.pop(stale, None)
+        return out
+
+    # -- follower: append / fence / snapshot ---------------------------------
+
+    def handle_append(self, body: dict) -> dict:
+        with self._lock:
+            term = int(body["term"])
+            if term < self.term:
+                return {"success": False, "term": self.term,
+                        "last_index": self.wal.last_index}
+            if term == self.term and self.is_leader:
+                # two leaders in one term cannot happen under master
+                # arbitration; refuse rather than silently abdicating
+                # (the master's next term bump resolves the conflict)
+                return {"success": False, "term": self.term,
+                        "last_index": self.wal.last_index}
+            if term > self.term:
+                self._step_down(term)
+            prev_i = int(body["prev_index"])
+            prev_t = int(body["prev_term"])
+            local_t = self.wal.term_at(prev_i)
+            if local_t is None:
+                if prev_i <= self.applied:
+                    # prev entry was compacted behind our snapshot: it is
+                    # covered, treat as matching
+                    pass
+                else:
+                    return {"success": False, "term": self.term,
+                            "last_index": self.wal.last_index}
+            elif local_t != prev_t:
+                self.wal.truncate_suffix(prev_i)
+                return {"success": False, "term": self.term,
+                        "last_index": self.wal.last_index}
+            new = []
+            for e in body.get("entries", []):
+                have = self.wal.term_at(e["index"])
+                if have is None and e["index"] > self.wal.last_index:
+                    new.append(e)
+                elif have is not None and have != e["term"]:
+                    self.wal.truncate_suffix(e["index"])
+                    new.append(e)
+                # else: already have it (duplicate delivery)
+            # drop entries that precede our snapshot horizon entirely
+            new = [e for e in new if e["index"] > self.applied]
+            if new:
+                start = new[0]["index"]
+                if start <= self.wal.last_index:
+                    self.wal.truncate_suffix(start)
+                self.wal.append(new, fsync=True)
+            commit = min(int(body["commit"]), self.wal.last_index)
+            if commit > self.commit:
+                self.wal.commit_index = commit
+                self.wal.save_meta()
+        self._apply_to_commit()
+        with self._lock:
+            return {"success": True, "term": self.term,
+                    "last_index": self.wal.last_index}
+
+    def handle_fence(self, term: int) -> dict:
+        """Master-driven fencing before promotion: adopt the new term
+        (rejecting any older leader's appends from now on) and report
+        log position so the master can pick the best candidate."""
+        with self._lock:
+            if term > self.term:
+                self._step_down(term)
+            return self.state()
+
+    def _step_down(self, term: int) -> None:
+        self.is_leader = False
+        if term > self.wal.term:
+            self.wal.term = term
+            self.wal.save_meta(fsync=True)
+
+    def become_leader(self, term: int, members: list[int]) -> dict:
+        with self._lock:
+            if term < self.term:
+                raise RpcError(409, f"stale term {term} < {self.term}")
+            self.wal.term = term
+            self.members = list(members)
+            self.is_leader = True
+            self._match = {}
+            self._next = {
+                p: self.wal.last_index + 1
+                for p in members if p != self.node_id
+            }
+            self.wal.save_meta(fsync=True)
+            # single-member group: everything in the log is committed
+            self._advance_commit()
+        self._apply_to_commit()
+        self.tick()
+        return self.state()
+
+    def set_members(self, term: int, members: list[int]) -> dict:
+        """Master-decreed membership change (reference: ChangeMember)."""
+        with self._lock:
+            if term < self.term:
+                raise RpcError(409, f"stale term {term} < {self.term}")
+            self.wal.term = term
+            self.members = list(members)
+            for p in members:
+                if p != self.node_id and p not in self._next:
+                    self._next[p] = self.wal.last_index + 1
+            self._match = {
+                p: v for p, v in self._match.items() if p in members
+            }
+            self.wal.save_meta(fsync=True)
+            if self.is_leader:
+                self._advance_commit()
+        self._apply_to_commit()
+        self.tick()
+        return self.state()
+
+    def handle_install_snapshot(self, body: dict) -> dict:
+        """Receive one chunk of a leader snapshot; install when done
+        (reference: snapshot.go 10MB chunk stream)."""
+        term = int(body["term"])
+        with self._lock:
+            if term < self.term or (term == self.term and self.is_leader):
+                return {"success": False, "term": self.term}
+            if term > self.term:
+                self._step_down(term)
+        sid = body["sid"]
+        with self._lock:
+            # drop abandoned streams (leader died mid-transfer): the
+            # staging buffers are snapshot-sized, they must not pile up
+            now = time.time()
+            for old_sid in [
+                s for s, st in self._snap_in.items()
+                if now - st["ts"] > 120.0
+            ]:
+                del self._snap_in[old_sid]
+            st = self._snap_in.setdefault(
+                sid, {"buf": bytearray(), "ts": now}
+            )
+            st["ts"] = now
+            buf: bytearray = st["buf"]
+            if int(body["off"]) != len(buf):
+                # duplicated/reordered chunk: nack so the leader restarts
+                # the stream instead of installing a corrupt archive
+                self._snap_in.pop(sid, None)
+                return {"success": False, "term": self.term,
+                        "error": "chunk out of order"}
+            buf += base64.b64decode(body["data"])
+            if not body.get("done"):
+                return {"success": True, "term": self.term}
+            del self._snap_in[sid]
+        snap_index = int(body["snap_index"])
+        with self._apply_lock:
+            if self.install_fn is not None:
+                self.install_fn(bytes(buf), snap_index)
+            with self._lock:
+                self.wal.reset(snap_index + 1)
+                self.wal.commit_index = snap_index
+                self.applied = snap_index
+                self.wal.save_meta(fsync=True)
+        return {"success": True, "term": self.term,
+                "last_index": self.wal.last_index}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def recover_singleton_commit(self) -> None:
+        """For single-member groups every fsync'd entry is committed:
+        recovery replays the whole log (the durability contract —
+        reference: WAL replay on restart)."""
+        with self._lock:
+            if len(self.members) <= 1:
+                self.wal.commit_index = max(
+                    self.wal.commit_index, self.wal.last_index
+                )
+        self._apply_to_commit()
+
+    def close(self) -> None:
+        self._stopped = True
+        self.wal.close()

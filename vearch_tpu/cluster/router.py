@@ -150,18 +150,27 @@ class RouterServer:
         unreachable node triggers a metadata refresh (the master may
         have promoted a replica) and a second attempt against the leader
         (reference: client.go:433-447 replica failover retry loop)."""
-        space = self._space(*space_key)
-        try:
-            return rpc.call(
-                self._partition_addr(space, pid, load_balance), "POST", path,
-                {**body, "partition_id": pid})
-        except RpcError as e:
-            if e.code != -1:
-                raise
-            self._invalidate_caches()
-            space = self._space(*space_key)
-            return rpc.call(self._partition_addr(space, pid), "POST", path,
-                            {**body, "partition_id": pid})
+        # -1: node unreachable; 421: replica is no longer the leader
+        # (raft failover moved it); 503: quorum not yet re-established.
+        # All mean the cluster is mid-failover: refresh metadata and
+        # retry with backoff until the master finishes promoting
+        # (reference: client.go:433-447 replica failover retry loop).
+        last: RpcError | None = None
+        for attempt in range(6):
+            if attempt:
+                self._invalidate_caches()
+                time.sleep(0.3 * attempt)
+            try:
+                space = self._space(*space_key)
+                lb = load_balance if attempt == 0 else "leader"
+                return rpc.call(
+                    self._partition_addr(space, pid, lb), "POST", path,
+                    {**body, "partition_id": pid})
+            except RpcError as e:
+                if e.code not in (-1, 421, 503):
+                    raise
+                last = e
+        raise last
 
     def _authenticate(self, headers, method, path) -> None:
         """BasicAuth via the master's /auth/check (positively cached 5s)

@@ -1,0 +1,200 @@
+"""Per-partition write-ahead log.
+
+TPU-native analogue of the reference's raft WAL (reference:
+internal/ps/storage/raftstore/store.go:124 wal storage under the
+partition path; tiglabs raft log semantics). The log is the durability
+and replication substrate: every write is fsync'd here before it is
+acked, replayed on recovery, shipped to followers, and truncated behind
+the periodic flush (store_raft_job.go:40).
+
+On-disk format, one file per partition (`wal.log`):
+    [u32 len][u32 crc32(payload)][payload json]
+Recovery stops at the first short/corrupt record (torn tail from a
+crash) and truncates the file there. A sidecar `wal.meta.json`
+(tmp+rename atomic) records first_index / term / commit_index.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Any
+
+_HDR = struct.Struct("<II")
+
+
+class Wal:
+    def __init__(self, dirpath: str):
+        os.makedirs(dirpath, exist_ok=True)
+        self.path = os.path.join(dirpath, "wal.log")
+        self.meta_path = os.path.join(dirpath, "wal.meta.json")
+        self._lock = threading.RLock()
+        # in-memory mirror: entry dicts {"index", "term", "op"} — the log
+        # tail is bounded by flush-truncation, so this stays modest
+        self._entries: list[dict] = []
+        self.first_index = 1  # index of the first entry retained in log
+        self.term = 0
+        self.commit_index = 0
+        self._load_meta()
+        self._recover()
+        self._fd = open(self.path, "ab")
+
+    # -- meta ----------------------------------------------------------------
+
+    def _load_meta(self) -> None:
+        if os.path.exists(self.meta_path):
+            with open(self.meta_path) as f:
+                m = json.load(f)
+            self.first_index = int(m.get("first_index", 1))
+            self.term = int(m.get("term", 0))
+            self.commit_index = int(m.get("commit_index", 0))
+
+    def save_meta(self, fsync: bool = False) -> None:
+        with self._lock:
+            tmp = self.meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({
+                    "first_index": self.first_index,
+                    "term": self.term,
+                    "commit_index": self.commit_index,
+                }, f)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, self.meta_path)
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good = 0
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break
+                ln, crc = _HDR.unpack(hdr)
+                payload = f.read(ln)
+                if len(payload) < ln or zlib.crc32(payload) != crc:
+                    break  # torn tail
+                self._entries.append(json.loads(payload))
+                good = f.tell()
+        actual = os.path.getsize(self.path)
+        if good < actual:
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+        # drop entries the meta says were already pruned (crash between
+        # file rewrite and meta update cannot happen — rewrite updates
+        # meta first; but be defensive)
+        while self._entries and self._entries[0]["index"] < self.first_index:
+            self._entries.pop(0)
+        if self._entries:
+            self.first_index = self._entries[0]["index"]
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def last_index(self) -> int:
+        with self._lock:
+            if self._entries:
+                return self._entries[-1]["index"]
+            return self.first_index - 1
+
+    @property
+    def last_term(self) -> int:
+        with self._lock:
+            return self._entries[-1]["term"] if self._entries else 0
+
+    def get(self, index: int) -> dict | None:
+        with self._lock:
+            i = index - self.first_index
+            if 0 <= i < len(self._entries):
+                return self._entries[i]
+            return None
+
+    def term_at(self, index: int) -> int | None:
+        """Term of the entry at `index`; 0 for the sentinel before the
+        log; None when the entry has been truncated away or is beyond
+        the end."""
+        if index == 0:
+            return 0
+        e = self.get(index)
+        return None if e is None else int(e["term"])
+
+    def entries_from(self, index: int, max_n: int = 512) -> list[dict]:
+        with self._lock:
+            i = max(0, index - self.first_index)
+            return list(self._entries[i : i + max_n])
+
+    # -- writes --------------------------------------------------------------
+
+    def append(self, entries: list[dict], fsync: bool = True) -> None:
+        if not entries:
+            return
+        with self._lock:
+            expect = self.last_index + 1
+            assert entries[0]["index"] == expect, (
+                f"append gap: {entries[0]['index']} != {expect}"
+            )
+            buf = bytearray()
+            for e in entries:
+                payload = json.dumps(e).encode()
+                buf += _HDR.pack(len(payload), zlib.crc32(payload))
+                buf += payload
+            self._fd.write(buf)
+            self._fd.flush()
+            if fsync:
+                os.fsync(self._fd.fileno())
+            self._entries.extend(entries)
+
+    def truncate_suffix(self, from_index: int) -> None:
+        """Drop entries >= from_index (conflict resolution on a follower
+        that diverged from the leader)."""
+        with self._lock:
+            if from_index > self.last_index:
+                return
+            keep = max(0, from_index - self.first_index)
+            self._entries = self._entries[:keep]
+            self._rewrite()
+
+    def truncate_prefix(self, new_first: int) -> None:
+        """Drop entries < new_first (log compaction behind a flush —
+        reference: store_raft_job.go:40 truncate job)."""
+        with self._lock:
+            if new_first <= self.first_index:
+                return
+            drop = min(new_first - self.first_index, len(self._entries))
+            self._entries = self._entries[drop:]
+            self.first_index = new_first
+            self._rewrite()
+
+    def reset(self, first_index: int) -> None:
+        """Clear the log entirely (after installing a snapshot at
+        first_index - 1)."""
+        with self._lock:
+            self._entries = []
+            self.first_index = first_index
+            self._rewrite()
+
+    def _rewrite(self) -> None:
+        self._fd.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self._entries:
+                payload = json.dumps(e).encode()
+                f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self.save_meta(fsync=True)
+        self._fd = open(self.path, "ab")
+
+    def close(self) -> None:
+        with self._lock:
+            self.save_meta()
+            self._fd.close()

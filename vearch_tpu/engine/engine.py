@@ -507,36 +507,45 @@ class Engine:
 
     # -- persistence (reference: engine.cc:1217 Dump / :1293 Load) ----------
 
-    def dump(self, dirpath: str | None = None) -> None:
-        dirpath = dirpath or self.data_dir
-        assert dirpath, "no data_dir configured"
-        os.makedirs(dirpath, exist_ok=True)
-        # two-phase: snapshot under the write lock (cheap — pointer copies
-        # and stable views of append-only arrays), then write to disk with
-        # the lock released so multi-GB dumps don't stall writers. A torn
-        # snapshot (keys longer than columns after load) was the original
-        # bug; lock-free disk writes are safe because every store is
-        # append-only with copy-on-grow.
+    def snapshot_state(self) -> dict:
+        """Phase 1 of a dump: capture a consistent point-in-time view
+        under the write lock. Cheap — pointer copies and stable views of
+        append-only copy-on-grow arrays. The caller may then persist it
+        lock-free with write_snapshot()."""
         with self._write_lock:
-            table_snap = self.table.snapshot()
-            bits = self.bitmap.snapshot(len(table_snap["keys"]))
-            vec_views = {
-                name: store.host_view()
-                for name, store in self.vector_stores.items()
+            return {
+                "table": self.table.snapshot(),
+                "bits": self.bitmap.snapshot(self.table.doc_count),
+                "vecs": {
+                    name: store.host_view()
+                    for name, store in self.vector_stores.items()
+                },
+                "status": int(self.status),
             }
-            status = int(self.status)
+
+    def write_snapshot(self, snap: dict, dirpath: str) -> None:
+        """Phase 2: persist a snapshot_state() capture. Runs without any
+        engine lock (a torn dump was the original bug; lock-free writes
+        of the captured views are safe because stores never mutate rows
+        in place)."""
+        os.makedirs(dirpath, exist_ok=True)
         with open(os.path.join(dirpath, "schema.json"), "w") as f:
             json.dump(self.schema.to_dict(), f)
-        self.table.dump_snapshot(table_snap, os.path.join(dirpath, "table"))
-        np.save(os.path.join(dirpath, "bitmap.npy"), bits)
-        for name, view in vec_views.items():
+        self.table.dump_snapshot(snap["table"], os.path.join(dirpath, "table"))
+        np.save(os.path.join(dirpath, "bitmap.npy"), snap["bits"])
+        for name, view in snap["vecs"].items():
             np.save(os.path.join(dirpath, f"vectors_{name}.npy"), view)
         for name, index in self.indexes.items():
             state = index.dump_state()
             if state:
                 np.savez(os.path.join(dirpath, f"index_{name}.npz"), **state)
         with open(os.path.join(dirpath, "engine.json"), "w") as f:
-            json.dump({"status": status}, f)
+            json.dump({"status": snap["status"]}, f)
+
+    def dump(self, dirpath: str | None = None) -> None:
+        dirpath = dirpath or self.data_dir
+        assert dirpath, "no data_dir configured"
+        self.write_snapshot(self.snapshot_state(), dirpath)
 
     def load(self, dirpath: str | None = None) -> None:
         dirpath = dirpath or self.data_dir
