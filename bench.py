@@ -91,10 +91,13 @@ def main():
     step = 100_000
     for i in range(0, n, step):
         eng.upsert([{"_id": f"d{j}", "emb": base[j]} for j in range(i, i + step)])
+        print(f"ingest {i + step}/{n} {time.time()-t0:.0f}s",
+              file=sys.stderr, flush=True)
     t_ingest = time.time() - t0
     t0 = time.time()
     eng.build_index()
     t_build = time.time() - t0
+    print(f"build done {t_build:.0f}s", file=sys.stderr, flush=True)
 
     idx = eng.indexes["emb"]
     req = SearchRequest(vectors={"emb": queries[:batch]}, k=10,
@@ -106,6 +109,21 @@ def main():
         res = eng.search(req)
     dt = (time.time() - t0) / iters
     qps = batch / dt
+
+    # single-query and small-batch latency (engine e2e, min of runs —
+    # the axon tunnel adds tens of ms of per-call jitter)
+    lat = {}
+    for b in (1, 32):
+        req_b = SearchRequest(vectors={"emb": queries[:b]}, k=10,
+                              include_fields=[],
+                              index_params={"rerank": 128})
+        eng.search(req_b)  # compile this batch shape
+        times = []
+        for _ in range(5):
+            t0 = time.time()
+            eng.search(req_b)
+            times.append(time.time() - t0)
+        lat[b] = min(times)
 
     # recall gate vs exact bf16 scan on device
     store = eng.vector_stores["emb"]
@@ -131,6 +149,8 @@ def main():
         "recall_at_10": round(recall, 4),
         "cpu_baseline_qps": round(cpu_qps, 1),
         "latency_ms_b1024": round(dt * 1e3, 1),
+        "latency_ms_b1": round(lat[1] * 1e3, 1),
+        "latency_ms_b32": round(lat[32] * 1e3, 1),
         "ingest_s": round(t_ingest, 1),
         "build_s": round(t_build, 1),
         "n": n, "d": d,

@@ -149,3 +149,72 @@ def test_ivfpq_dump_load_preserves_search(rng, tmp_path):
     assert eng2.indexes["emb"].trained
     res = eng2.search(SearchRequest(vectors={"emb": vecs[11:12]}, k=3))
     assert res[0].items[0].key == "d11"
+
+
+def test_int8_scan_blockmax_matches_exact():
+    """Forced block-max two-stage top-k returns the same top candidates
+    as exact lax.top_k on a well-separated dataset (id reconstruction
+    across blocks is the failure mode to catch)."""
+    import jax.numpy as jnp
+
+    from vearch_tpu.engine.types import MetricType
+    from vearch_tpu.ops.ivf import int8_scan_candidates
+
+    rng = np.random.default_rng(7)
+    n, d = 512 * 64, 32  # 64 blocks, enough for nb*4 with nb=32
+    base = rng.integers(-100, 100, (n, d)).astype(np.int8)
+    scale = np.ones(n, np.float32)
+    vsq = np.sum((base.astype(np.float32)) ** 2, axis=1)
+    valid = np.ones(n, bool)
+    q = base[rng.choice(n, 8, replace=False)].astype(np.float32)
+
+    args = (jnp.asarray(q), jnp.asarray(base), jnp.asarray(scale),
+            jnp.asarray(vsq), jnp.asarray(valid))
+    es, ei = int8_scan_candidates(*args, 32, MetricType.L2, "exact")
+    bs, bi = int8_scan_candidates(*args, 32, MetricType.L2, "blockmax")
+    es, ei, bs, bi = map(np.asarray, (es, ei, bs, bi))
+    # top-1 self-match must survive block selection exactly
+    np.testing.assert_array_equal(ei[:, 0], bi[:, 0])
+    # strong overlap in the candidate pool (blockmax is allowed to drop
+    # a shadowed tail candidate, not the head)
+    for row in range(8):
+        overlap = len(set(ei[row, :10].tolist()) & set(bi[row, :10].tolist()))
+        assert overlap >= 9, (row, overlap)
+
+
+def test_blockmax_never_resurrects_filtered_docs():
+    """Selective filter + blockmax: masked slots must come back as id=-1,
+    never as real docids that rerank could rescore into results (review
+    r2 finding — exact_rerank masks only id>=0, not validity)."""
+    import jax.numpy as jnp
+
+    from vearch_tpu.engine.types import MetricType
+    from vearch_tpu.ops.ivf import int8_scan_candidates
+
+    rng = np.random.default_rng(3)
+    n, d = 512 * 64, 16
+    base = rng.integers(-100, 100, (n, d)).astype(np.int8)
+    vsq = np.sum(base.astype(np.float32) ** 2, axis=1)
+    valid = np.zeros(n, bool)
+    allowed = rng.choice(n, 40, replace=False)
+    valid[allowed] = True  # only 40 of 32k docs pass the filter
+    q = rng.standard_normal((4, d)).astype(np.float32)
+
+    for mode in ("exact", "blockmax"):
+        s, i = int8_scan_candidates(
+            jnp.asarray(q), jnp.asarray(base),
+            jnp.asarray(np.ones(n, np.float32)), jnp.asarray(vsq),
+            jnp.asarray(valid), 128, MetricType.L2, mode)
+        s, i = np.asarray(s), np.asarray(i)
+        real = i[i >= 0]
+        assert set(real.tolist()) <= set(allowed.tolist()), mode
+        # every -inf slot is id -1
+        assert np.all(i[~np.isfinite(s)] == -1), mode
+
+    # forced blockmax on a tiny space degrades gracefully, no crash
+    small = base[:1024]
+    s, i = int8_scan_candidates(
+        jnp.asarray(q), jnp.asarray(small),
+        jnp.asarray(np.ones(1024, np.float32)), jnp.asarray(vsq[:1024]),
+        jnp.asarray(np.ones(1024, bool)), 128, MetricType.L2, "blockmax")
+    assert np.asarray(s).shape[0] == 4

@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Any, Callable
 
+import numpy as np
+
 from vearch_tpu.cluster.rpc import RpcError
 from vearch_tpu.cluster.wal import Wal
 
@@ -261,7 +263,9 @@ class RaftNode:
                     "pid": self.pid, "term": term, "sid": sid,
                     "snap_index": snap_index,
                     "off": off, "total": len(data),
-                    "data": base64.b64encode(chunk).decode(),
+                    # raw bytes over the binary tensor codec (the
+                    # reference streams raw 10MB chunks too)
+                    "data": np.frombuffer(chunk, dtype=np.uint8),
                     "done": off + SNAP_CHUNK >= len(data),
                 })
                 if not resp.get("success"):
@@ -454,7 +458,11 @@ class RaftNode:
                 self._snap_in.pop(sid, None)
                 return {"success": False, "term": self.term,
                         "error": "chunk out of order"}
-            buf += base64.b64decode(body["data"])
+            data = body["data"]
+            if isinstance(data, str):  # legacy base64 framing
+                buf += base64.b64decode(data)
+            else:
+                buf += bytes(memoryview(np.asarray(data, dtype=np.uint8)))
             if not body.get("done"):
                 return {"success": True, "term": self.term}
             del self._snap_in[sid]

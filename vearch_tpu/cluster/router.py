@@ -278,29 +278,31 @@ class RouterServer:
                 if k not in known:
                     raise RpcError(400, f"unknown field {k!r}")
 
-    def _parse_vectors(self, space: Space, body: dict) -> dict[str, list]:
+    def _parse_vectors(self, space: Space, body: dict) -> dict[str, Any]:
         """reference: doc_query.go:165 parseSearch — `vectors` is a list of
-        {field, feature} with feature a flattened batch."""
-        out: dict[str, list] = {}
+        {field, feature} with feature a flattened batch. Parsed into
+        [b, d] float32 arrays so the router->PS hop rides the binary
+        tensor codec instead of JSON float lists."""
+        import numpy as np
+
+        out: dict[str, Any] = {}
         nq = None
         for v in body.get("vectors", []):
             f = space.schema.field(v["field"])
-            feat = v["feature"]
+            feat = np.asarray(v["feature"], dtype=np.float32).ravel()
             wd = max(f.wire_dim, 1)
-            if len(feat) % wd != 0:
+            if feat.shape[0] % wd != 0:
                 raise RpcError(
                     400,
-                    f"feature length {len(feat)} not divisible by "
+                    f"feature length {feat.shape[0]} not divisible by "
                     f"dimension {wd}",
                 )
-            b = len(feat) // wd
+            b = feat.shape[0] // wd
             if nq is None:
                 nq = b
             elif nq != b:
                 raise RpcError(400, "inconsistent query batch across fields")
-            out[v["field"]] = [
-                feat[i * wd : (i + 1) * wd] for i in range(b)
-            ]
+            out[v["field"]] = feat.reshape(b, wd)
         if not out:
             raise RpcError(400, "search requires `vectors`")
         return out

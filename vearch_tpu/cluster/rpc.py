@@ -1,15 +1,24 @@
-"""Minimal JSON-over-HTTP RPC layer (stdlib only).
+"""JSON+binary-tensor RPC layer over HTTP (stdlib only).
 
 Plays the role of the reference's rpcx+protobuf transport (reference:
 internal/pkg/server/rpc/rpc_server.go:33 — custom codec, handler chains
-with panic recovery, per-handler timeouts). JSON keeps round 1 dependency
--free; the wire format is isolated behind `call()` / `JsonRpcServer` so a
-binary codec (C++ extension) can replace it without touching services.
+with panic recovery, per-handler timeouts). Control payloads are JSON;
+numpy arrays anywhere in a body are extracted into raw little-endian
+buffers appended after a JSON skeleton (`_encode`/`_decode`), so a
+[1024, 128] f32 query batch rides the wire as 512 KB of bytes instead
+of ~1.4 MB of parsed-float JSON — the reference's custom rpcx codec
+serves the same purpose for its vector payloads.
+
+Wire format (Content-Type: application/x-vearch-tensors):
+    [u32 header_len][header json][tensor 0 bytes][tensor 1 bytes]...
+header = {"body": <json, ndarray leaves replaced by {"__tensor__": i}>,
+          "tensors": [{"dtype", "shape"}, ...]}
 """
 
 from __future__ import annotations
 
 import json
+import struct
 import threading
 import time
 import traceback
@@ -18,7 +27,76 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+import numpy as np
+
 from vearch_tpu.cluster.metrics import Registry
+
+JSON_CT = "application/json"
+BIN_CT = "application/x-vearch-tensors"
+_U32 = struct.Struct("<I")
+
+
+def _extract_tensors(obj: Any, out: list) -> Any:
+    """Replace ndarray leaves with placeholders, collecting buffers."""
+    if isinstance(obj, np.ndarray):
+        idx = len(out)
+        out.append(obj)
+        return {"__tensor__": idx}
+    if isinstance(obj, dict):
+        return {k: _extract_tensors(v, out) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_extract_tensors(v, out) for v in obj]
+    return obj
+
+
+def _restore_tensors(obj: Any, tensors: list[np.ndarray]) -> Any:
+    if isinstance(obj, dict):
+        if "__tensor__" in obj and len(obj) == 1:
+            return tensors[obj["__tensor__"]]
+        return {k: _restore_tensors(v, tensors) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore_tensors(v, tensors) for v in obj]
+    return obj
+
+
+def _encode(body: Any) -> tuple[str, bytes]:
+    """JSON when tensor-free; binary framing otherwise."""
+    tensors: list[np.ndarray] = []
+    skeleton = _extract_tensors(body, tensors)
+    if not tensors:
+        return JSON_CT, json.dumps(body).encode()
+    arrays = [np.ascontiguousarray(t) for t in tensors]
+    header = json.dumps({
+        "body": skeleton,
+        "tensors": [
+            {"dtype": a.dtype.str, "shape": list(a.shape)} for a in arrays
+        ],
+    }).encode()
+    parts = [_U32.pack(len(header)), header]
+    parts.extend(a.tobytes() for a in arrays)
+    return BIN_CT, b"".join(parts)
+
+
+def _decode(content_type: str, raw: bytes) -> Any:
+    if not raw:
+        return None
+    if not content_type.startswith(BIN_CT):
+        return json.loads(raw)
+    hlen = _U32.unpack_from(raw, 0)[0]
+    header = json.loads(raw[4 : 4 + hlen])
+    off = 4 + hlen
+    tensors = []
+    for meta in header["tensors"]:
+        dt = np.dtype(meta["dtype"])
+        n = int(np.prod(meta["shape"], dtype=np.int64)) if meta["shape"] \
+            else 1
+        nbytes = n * dt.itemsize
+        arr = np.frombuffer(raw, dtype=dt, count=n, offset=off).reshape(
+            meta["shape"]
+        )
+        off += nbytes
+        tensors.append(arr)
+    return _restore_tensors(header["body"], tensors)
 
 
 class RpcError(Exception):
@@ -107,7 +185,9 @@ class JsonRpcServer:
                         outer.authenticator(self.headers, method, prefix)
                     length = int(self.headers.get("Content-Length") or 0)
                     raw = self.rfile.read(length) if length else b""
-                    body = json.loads(raw) if raw else None
+                    body = _decode(
+                        self.headers.get("Content-Type") or JSON_CT, raw
+                    )
                     match = outer._match(method, self.path)
                     handler, parts = match
                     if handler is not None:
@@ -133,9 +213,9 @@ class JsonRpcServer:
                     outer._m_latency.observe(time.time() - t0, method, prefix)
 
             def _reply(self, status: int, obj: dict):
-                data = json.dumps(obj).encode()
+                ct, data = _encode(obj)
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ct)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -210,12 +290,16 @@ def call(
     timeout: float = 120.0,
     auth: tuple[str, str] | None = None,
 ) -> Any:
-    """Client side: raises RpcError on non-zero code."""
+    """Client side: raises RpcError on non-zero code. Bodies containing
+    numpy arrays ride the binary tensor codec automatically."""
     import base64
 
     url = f"http://{addr}{path}"
-    data = json.dumps(body).encode() if body is not None else None
-    headers = {"Content-Type": "application/json"}
+    if body is not None:
+        ct, data = _encode(body)
+    else:
+        ct, data = JSON_CT, None
+    headers = {"Content-Type": ct}
     if auth is not None:
         token = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
         headers["Authorization"] = f"Basic {token}"
@@ -224,7 +308,9 @@ def call(
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            payload = json.loads(resp.read())
+            payload = _decode(
+                resp.headers.get("Content-Type") or JSON_CT, resp.read()
+            )
     except urllib.error.HTTPError as e:
         try:
             payload = json.loads(e.read())

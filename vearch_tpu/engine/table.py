@@ -111,6 +111,31 @@ class Table:
                 out[name] = lst[docid]
         return out
 
+    def gather_rows(
+        self, docids: np.ndarray, names: list[str] | None = None
+    ) -> list[dict[str, Any]]:
+        """Batch get_fields: one numpy gather per fixed column instead of
+        a Python loop per (doc, field) — the search result shaping hot
+        path (r1 VERDICT weak-3)."""
+        cols: dict[str, list] = {}
+        for name, col in self._fixed.items():
+            if names is None or name in names:
+                cols[name] = col._data[docids].tolist()
+        for name, lst in self._strings.items():
+            if names is None or name in names:
+                cols[name] = [lst[i] for i in docids.tolist()]
+        field_names = list(cols)
+        if not field_names:
+            return [{} for _ in range(len(docids))]
+        return [
+            dict(zip(field_names, vals))
+            for vals in zip(*(cols[f] for f in field_names))
+        ]
+
+    def keys_for(self, docids: np.ndarray) -> list[str]:
+        keys = self._keys
+        return [keys[i] for i in docids.tolist()]
+
     def column(self, name: str) -> np.ndarray:
         """Columnar view of a fixed-width field (for scalar index builds /
         filter evaluation). Raises KeyError for string fields."""

@@ -49,7 +49,10 @@ class Int8Mirror:
         start = self._n if start is None else start
         need = start + q8.shape[0]
         if self._h8.shape[0] < need:
+            # capacity stays 512-aligned: the block-max top-k reshapes
+            # the score row into [n/512, 512] blocks (ops/ivf.py)
             cap = max(need, self._h8.shape[0] * 2, 1024)
+            cap = -(-cap // 512) * 512
             g8 = np.zeros((cap, self.dimension), dtype=np.int8)
             gs = np.zeros(cap, dtype=np.float32)
             gv = np.zeros(cap, dtype=np.float32)

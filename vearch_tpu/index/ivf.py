@@ -388,9 +388,12 @@ class IVFPQIndex(_IVFBase):
             n_pad = approx8.shape[0]
             valid = to_device_mask(valid_mask, self.indexed_count, n_pad)
             r = min(self._rerank_depth(k, params), max(self.indexed_count, 1))
+            topk_mode = (params or {}).get(
+                "topk_mode", self.params.get("topk_mode", "auto")
+            )
             cand_s, cand_i = ivf_ops.int8_scan_candidates(
                 jnp.asarray(q), approx8, scale, vsq, valid,
-                max(r, k), metric,
+                max(r, k), metric, topk_mode,
             )
         else:
             if self._dirty or self._bucket_resid8 is None:
