@@ -107,6 +107,29 @@ class RawVectorStore:
             self._device_rows = n
         return self._device, self._device_sqnorm, n
 
+    _sh_cache = None
+    _sh_sqnorm: jax.Array | None = None
+
+    def device_buffer_sharded(self, mesh) -> tuple[jax.Array, jax.Array, int]:
+        """Row-sharded raw buffer over the mesh "data" axis (rerank path
+        of a mesh-spanning partition). Re-placed in full when rows grew;
+        see Int8Mirror.flush_sharded for the trade-off."""
+        from vearch_tpu.ops.distance import sqnorms as _sqnorms
+        from vearch_tpu.parallel.mesh import ShardedRowCache
+
+        if self._sh_cache is None:
+            self._sh_cache = ShardedRowCache(align=128)
+
+        def build(cap):
+            host = np.zeros((cap, self.dimension), dtype=np.float32)
+            host[: self._n] = self._host[: self._n]
+            return (host.astype(self.store_dtype),)
+
+        (base,), rebuilt = self._sh_cache.get(mesh, self._n, build)
+        if rebuilt or self._sh_sqnorm is None:
+            self._sh_sqnorm = _sqnorms(base)
+        return base, self._sh_sqnorm, self._n
+
     # -- persistence ---------------------------------------------------------
 
     def dump(self, path: str) -> None:
@@ -119,3 +142,6 @@ class RawVectorStore:
             self._n = data.shape[0]
             self._device = None
             self._device_rows = 0
+            if self._sh_cache is not None:
+                self._sh_cache.invalidate()
+            self._sh_sqnorm = None

@@ -65,9 +65,44 @@ class Int8Mirror:
         self._h_scale[sl] = scale
         self._h_vsq[sl] = vsq
         self._n = max(self._n, need)
+        # rows below the mirrored high-water mark were overwritten
+        # (re-absorb after load_state): force re-upload from `start`
+        if start < self._d_rows:
+            self._d_rows = start
+        if self._sh_cache is not None:
+            self._sh_cache.lower_rows(start)
 
     def append(self, rows: np.ndarray, start: int | None = None) -> None:
         self.append_quantized(*quantize_rows(rows), start=start)
+
+    def flush_sharded(self, mesh) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """Device views row-sharded over the mesh "data" axis — one
+        logical partition spanning all chips (the capacity regime: rows
+        beyond a single chip's HBM). Rows are padded so every shard is
+        512-aligned (block-max top-k contract). The sharded copy is
+        re-placed in full when rows grew since the last flush — mesh
+        mode trades incremental tail updates for capacity; realtime
+        ingest still lands through absorb + re-flush.
+        """
+        if self._sh_cache is None:
+            from vearch_tpu.parallel.mesh import ShardedRowCache
+
+            self._sh_cache = ShardedRowCache(align=512)
+
+        def build(cap):
+            h8 = np.zeros((cap, self.dimension), dtype=np.int8)
+            hs = np.zeros(cap, dtype=np.float32)
+            hv = np.zeros(cap, dtype=np.float32)
+            n = self._n
+            h8[:n] = self._h8[:n]
+            hs[:n] = self._h_scale[:n]
+            hv[:n] = self._h_vsq[:n]
+            return h8, hs, hv
+
+        arrays, _ = self._sh_cache.get(mesh, self._n, build)
+        return arrays
+
+    _sh_cache = None
 
     def flush(self) -> tuple[jax.Array, jax.Array, jax.Array]:
         """Device views [cap, d] / [cap] / [cap]; rows >= count are padding."""

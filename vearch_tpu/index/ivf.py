@@ -282,6 +282,16 @@ class IVFPQIndex(_IVFBase):
         self.ksub = 1 << int(params.get("nbits_per_idx", params.get("nbits", 8)))
         self.scan_mode = str(params.get("scan_mode", "auto"))
         self.full_scan_limit = int(params.get("full_scan_limit", 16_000_000))
+        # one partition spanning the whole device mesh (capacity regime:
+        # rows beyond a single chip's HBM — SURVEY §2.3 "intra-node
+        # parallelism", the axis the reference lacks). "auto" engages
+        # when more than one device is visible.
+        dp = params.get("data_parallel", False)
+        import jax as _jax
+
+        self.data_parallel = (
+            len(_jax.devices()) > 1 if dp == "auto" else bool(dp)
+        )
         self.codebooks: jax.Array | None = None  # [m, ksub, dsub]
         self._codes: np.ndarray | None = None  # [n_indexed, m] host codes
         # probe-mode state (bucket-grouped)
@@ -382,7 +392,15 @@ class IVFPQIndex(_IVFBase):
         )
         mode = (params or {}).get("scan_mode", self.scan_mode)
         if mode == "auto":
-            mode = "full" if self.indexed_count <= self.full_scan_limit else "probe"
+            # the full-scan budget is per chip: a mesh-spanning
+            # partition scans its rows in parallel, so the cliff to
+            # probe mode scales with the mesh
+            limit = self.full_scan_limit
+            if self.data_parallel:
+                limit *= max(len(jax.devices()), 1)
+            mode = "full" if self.indexed_count <= limit else "probe"
+        if mode == "full" and self.data_parallel:
+            return self._search_mesh(q, k, valid_mask, params, metric)
         if mode == "full":
             approx8, scale, vsq = self._mirror.flush()
             n_pad = approx8.shape[0]
@@ -447,6 +465,64 @@ class IVFPQIndex(_IVFBase):
             base_sqnorm,
             min(k, int(cand_i.shape[1])),
             self.metric,
+        )
+        scores, ids = jax.device_get((scores, ids))
+        return self._pad_to_k(scores, ids, k)
+
+    def _search_mesh(
+        self, q: np.ndarray, k: int, valid_mask, params, metric
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Mesh-spanning full scan: the int8 mirror and the raw rerank
+        buffer are row-sharded over all devices; candidate merge is an
+        all_gather + re-top-k, rerank merge a pmax — no host round trips
+        (reference analogue: none; this is the TPU capacity axis on top
+        of the reference's partition sharding)."""
+        from vearch_tpu.parallel import mesh as mesh_lib
+        from vearch_tpu.parallel.sharded import (
+            sharded_exact_rerank,
+            sharded_int8_search,
+        )
+
+        mesh = mesh_lib.default_mesh()
+        a8, scale, vsq = self._mirror.flush_sharded(mesh)
+        n = self.indexed_count
+        # the sharded mask re-uploads only when the engine handed us a
+        # different mask object (the engine caches its alive mask per
+        # bitmap version; filter masks are fresh arrays by nature). The
+        # strong reference to the source mask makes the identity check
+        # sound — a live object's id cannot be reused.
+        cap = self._mirror._sh_cache.capacity(mesh, n)
+        fresh = not (
+            getattr(self, "_mesh_valid_src", None) is valid_mask
+            and valid_mask is not None
+            and getattr(self, "_mesh_valid_n", -1) == n
+            and getattr(self, "_mesh_valid_cap", -1) == cap
+        )
+        if fresh:
+            host_valid = np.zeros(cap, dtype=bool)
+            if valid_mask is None:
+                host_valid[:n] = True
+            else:
+                vm = np.asarray(valid_mask)[:n]
+                host_valid[: vm.shape[0]] = vm
+            self._mesh_valid, _ = mesh_lib.shard_rows(mesh, host_valid)
+            self._mesh_valid_src = valid_mask
+            self._mesh_valid_n = n
+            self._mesh_valid_cap = cap
+        valid_sh = self._mesh_valid
+        qrep = mesh_lib.replicate(mesh, np.asarray(q, np.float32))
+        r = min(self._rerank_depth(k, params), max(n, 1))
+        topk_mode = (params or {}).get(
+            "topk_mode", self.params.get("topk_mode", "auto")
+        )
+        cand_s, cand_i = sharded_int8_search(
+            mesh, a8, scale, vsq, valid_sh, qrep, max(r, k), metric,
+            topk_mode,
+        )
+        base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
+        scores, ids = sharded_exact_rerank(
+            mesh, qrep.astype(base.dtype), cand_i, base, base_sqn,
+            min(k, int(cand_i.shape[1])), self.metric,
         )
         scores, ids = jax.device_get((scores, ids))
         return self._pad_to_k(scores, ids, k)

@@ -21,6 +21,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_default_mesh: Mesh | None = None
+
+
+def default_mesh() -> Mesh:
+    """Process-wide all-devices mesh, rows on "data" (cached: mesh
+    identity matters for jit cache hits)."""
+    global _default_mesh
+    if _default_mesh is None or (
+        _default_mesh.size != len(jax.devices())
+    ):
+        _default_mesh = make_mesh(query_axis=1)
+    return _default_mesh
+
+
 def make_mesh(
     n_devices: int | None = None,
     data_axis: int | None = None,
@@ -79,3 +93,47 @@ def replicate(mesh: Mesh, x):
 
     spec = P(*([None] * np.ndim(x)))
     return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+
+class ShardedRowCache:
+    """Grow-only cache of host row arrays placed row-sharded on a mesh.
+
+    One invalidation point for every sharded device buffer (int8 mirror,
+    raw rerank base, ...): `get` rebuilds when capacity changed or rows
+    grew past the cached high-water mark; `lower_rows` must be called
+    when rows BELOW the high-water mark were overwritten (re-absorb,
+    engine load) so the next get re-places instead of serving stale
+    rows; `invalidate` drops everything.
+    """
+
+    def __init__(self, align: int):
+        self.align = align
+        self._key = None
+        self._rows = 0
+        self.arrays: tuple | None = None
+
+    def capacity(self, mesh: Mesh, n: int) -> int:
+        unit = self.align * mesh.shape["data"]
+        return -(-max(n, 1) // unit) * unit
+
+    def get(self, mesh: Mesh, n: int, build_host_fn):
+        """build_host_fn(cap) -> tuple of host arrays with cap rows.
+        Returns (device_arrays, rebuilt)."""
+        cap = self.capacity(mesh, n)
+        key = (id(mesh), cap)
+        rebuilt = False
+        if self._key != key or self._rows < n or self.arrays is None:
+            hosts = build_host_fn(cap)
+            self.arrays = tuple(shard_rows(mesh, h)[0] for h in hosts)
+            self._key = key
+            self._rows = n
+            rebuilt = True
+        return self.arrays, rebuilt
+
+    def lower_rows(self, start: int) -> None:
+        self._rows = min(self._rows, start)
+
+    def invalidate(self) -> None:
+        self._key = None
+        self._rows = 0
+        self.arrays = None

@@ -23,8 +23,10 @@ from jax.experimental.shard_map import shard_map
 
 from vearch_tpu.engine.types import MetricType
 from vearch_tpu.ops import kmeans as km
-from vearch_tpu.ops.distance import brute_force_search
+from vearch_tpu.ops.distance import brute_force_search, dot_precision, sqnorms
 from vearch_tpu.parallel import mesh as mesh_lib
+
+NEG_INF = float("-inf")
 
 
 def sharded_flat_search(
@@ -69,6 +71,7 @@ def sharded_int8_search(
     queries: jax.Array,    # [B_pad, d] f32 sharded P("query", None)
     r: int,
     metric: MetricType = MetricType.L2,
+    topk_mode: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
     """Sharded compressed scan (the IVFPQ full-scan path across chips)."""
     from vearch_tpu.ops.ivf import int8_scan_candidates
@@ -85,9 +88,12 @@ def sharded_int8_search(
     )
     def run(a8, sc, vsq, v, q):
         local_r = min(r, a8.shape[0])
-        scores, ids = int8_scan_candidates(q, a8, sc, vsq, v, local_r, metric)
+        scores, ids = int8_scan_candidates(q, a8, sc, vsq, v, local_r,
+                                           metric, topk_mode)
         shard = jax.lax.axis_index("data")
-        gids = ids + shard * a8.shape[0]
+        # masked candidates come back as id=-1; keep them -1 globally
+        # (a bare shard offset would turn them into real foreign docids)
+        gids = jnp.where(ids >= 0, ids + shard * a8.shape[0], -1)
         all_s = jax.lax.all_gather(scores, "data", axis=1, tiled=True)
         all_i = jax.lax.all_gather(gids, "data", axis=1, tiled=True)
         rr = min(r, all_s.shape[1])
@@ -95,6 +101,59 @@ def sharded_int8_search(
         return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
     return run(approx8, row_scale, row_vsq, valid, queries)
+
+
+def sharded_exact_rerank(
+    mesh: Mesh,
+    queries: jax.Array,     # [B, d] replicated
+    cand_ids: jax.Array,    # [B, r] i32 global docids, replicated
+    base: jax.Array,        # [N_pad, d] sharded P("data", None)
+    base_sqnorm: jax.Array,  # [N_pad] sharded P("data")
+    k: int,
+    metric: MetricType = MetricType.L2,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact re-scoring against a row-sharded raw buffer: every shard
+    scores the candidates it owns (others -inf), pmax over "data" merges
+    without leaving the device, then one small top-k. The mesh analogue
+    of ops/ivf.py exact_rerank."""
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None), P(None, None), P("data", None), P("data")),
+        out_specs=(P(None, None), P(None, None)),
+        check_rep=False,
+    )
+    def run(q, cids, b, sqn):
+        shard = jax.lax.axis_index("data")
+        local_n = b.shape[0]
+        local = cids - shard * local_n
+        mine = (cids >= 0) & (local >= 0) & (local < local_n)
+        safe = jnp.clip(local, 0, local_n - 1)
+        vecs = b[safe]  # [B, r, d]
+        vsq = sqn[safe]
+        qf = q.astype(b.dtype)
+        dots = jax.lax.dot_general(
+            qf, vecs, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=dot_precision(qf, vecs),
+        )
+        if metric is MetricType.L2:
+            scores = -(sqnorms(qf)[:, None] - 2.0 * dots + vsq)
+        elif metric is MetricType.COSINE:
+            qn = jnp.sqrt(jnp.maximum(sqnorms(qf), 1e-30))[:, None]
+            vn = jnp.sqrt(jnp.maximum(vsq, 1e-30))
+            scores = dots / (qn * vn)
+        else:
+            scores = dots
+        scores = jnp.where(mine, scores, NEG_INF)
+        scores = jax.lax.pmax(scores, "data")  # replicated merge
+        kk = min(k, scores.shape[1])
+        top_s, pos = jax.lax.top_k(scores, kk)
+        ids = jnp.take_along_axis(cids, pos, axis=1)
+        return top_s, jnp.where(jnp.isfinite(top_s), ids, -1)
+
+    return run(queries, cand_ids, base, base_sqnorm)
 
 
 def sharded_kmeans_step(
