@@ -1,0 +1,157 @@
+"""Range partition-rule tests (reference: entity/partition.go:125
+PartitionRule, space.go:198 PartitionIdsByRangeField,
+test_module_partition.py — date-partitioned space, online ADD/DROP)."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+DAY_MS = 86_400_000
+T0 = 1_700_000_000_000  # epoch millis base
+
+
+def make_space(cl, ranges, partition_num=2):
+    cl.create_space("db", {
+        "name": "s", "partition_num": partition_num, "replica_num": 1,
+        "partition_rule": {
+            "type": "RANGE", "field": "ts",
+            "ranges": ranges,
+        },
+        "fields": [
+            {"name": "ts", "data_type": "date"},
+            {"name": "v", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+
+
+@pytest.fixture
+def rule_cluster(tmp_path):
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        yield c, cl
+
+
+def test_rule_space_topology_and_routing(rule_cluster, rng):
+    c, cl = rule_cluster
+    # three day-ranges x 2 partitions = 6 partitions (reference:
+    # test_module_partition asserts partitions == ranges * partition_num)
+    make_space(cl, [
+        {"name": "p0", "value": (T0 + 1 * DAY_MS) // 1000},
+        {"name": "p1", "value": (T0 + 2 * DAY_MS) // 1000},
+        {"name": "p2", "value": (T0 + 3 * DAY_MS) // 1000},
+    ])
+    sp = cl.get_space("db", "s")
+    assert len(sp["partitions"]) == 6
+    groups = {p["group"] for p in sp["partitions"]}
+    assert groups == {"p0", "p1", "p2"}
+
+    vecs = rng.standard_normal((90, D)).astype(np.float32)
+    docs = [
+        {"_id": f"d{i}", "ts": T0 + (i % 3) * DAY_MS + 1000, "v": vecs[i]}
+        for i in range(90)
+    ]
+    cl.upsert("db", "s", docs)
+
+    # day-(i%3) docs land only in group p(i%3)
+    ps_engines = {}
+    for ps in c.ps_nodes:
+        ps_engines.update(ps.engines)
+    by_group = {g: 0 for g in ("p0", "p1", "p2")}
+    for p in sp["partitions"]:
+        by_group[p["group"]] += ps_engines[p["id"]].doc_count
+    assert by_group == {"p0": 30, "p1": 30, "p2": 30}, by_group
+
+    # search spans all groups
+    hits = cl.search("db", "s", [{"field": "v", "feature": vecs[7]}],
+                     limit=1)
+    assert hits[0][0]["_id"] == "d7"
+    # id query works without knowing the rule value
+    docs = cl.query("db", "s", document_ids=["d5", "d55"])
+    assert {d["_id"] for d in docs} == {"d5", "d55"}
+
+    # out-of-range value is rejected loudly
+    with pytest.raises(rpc.RpcError, match="no partition range"):
+        cl.upsert("db", "s", [{"_id": "late", "ts": T0 + 30 * DAY_MS,
+                               "v": vecs[0]}])
+    # missing rule field is rejected
+    with pytest.raises(rpc.RpcError, match="missing"):
+        cl.upsert("db", "s", [{"_id": "x", "v": vecs[0]}])
+
+
+def test_rule_add_and_drop_partitions(rule_cluster, rng):
+    c, cl = rule_cluster
+    make_space(cl, [
+        {"name": "p0", "value": (T0 + 1 * DAY_MS) // 1000},
+        {"name": "p1", "value": (T0 + 2 * DAY_MS) // 1000},
+    ], partition_num=1)
+    vecs = rng.standard_normal((40, D)).astype(np.float32)
+    cl.upsert("db", "s", [
+        {"_id": f"d{i}", "ts": T0 + (i % 2) * DAY_MS + 1000, "v": vecs[i]}
+        for i in range(40)
+    ])
+
+    # day-2 docs don't fit yet
+    with pytest.raises(rpc.RpcError, match="no partition range"):
+        cl.upsert("db", "s", [{"_id": "n0", "ts": T0 + 2 * DAY_MS + 1,
+                               "v": vecs[0]}])
+
+    # ADD a new range online (reference: test_add_partitions)
+    rpc.call(c.router_addr, "POST", "/partitions/rule", {
+        "db_name": "db", "space_name": "s", "operator_type": "ADD",
+        "partition_rule": {"ranges": [
+            {"name": "p2", "value": (T0 + 3 * DAY_MS) // 1000},
+        ]},
+    })
+    sp = cl.get_space("db", "s")
+    assert len(sp["partitions"]) == 3
+    cl.upsert("db", "s", [{"_id": "n0", "ts": T0 + 2 * DAY_MS + 1,
+                           "v": vecs[0]}])
+    hits = cl.search("db", "s", [{"field": "v", "feature": vecs[0]}],
+                     limit=2)
+    assert {h["_id"] for h in hits[0]} == {"d0", "n0"}
+
+    # DROP the oldest range live (reference: test_drop_partitions)
+    rpc.call(c.router_addr, "POST", "/partitions/rule", {
+        "db_name": "db", "space_name": "s", "operator_type": "DROP",
+        "partition_name": "p0",
+    })
+    sp = cl.get_space("db", "s")
+    assert len(sp["partitions"]) == 2
+    assert {r["name"] for r in sp["partition_rule"]["ranges"]} == \
+        {"p1", "p2"}
+    # day-0 docs are gone; day-1 survive
+    hits = cl.search("db", "s", [{"field": "v", "feature": vecs[2]}],
+                     limit=40)
+    ids = {h["_id"] for h in hits[0]}
+    assert not any(int(i[1:]) % 2 == 0 for i in ids if i.startswith("d")), ids
+    assert "d1" in ids
+    # reference semantics: ranges are pure upper bounds — a value below
+    # the (new) lowest bound routes into the lowest remaining range
+    cl.upsert("db", "s", [{"_id": "old", "ts": T0 + 1000, "v": vecs[1]}])
+    docs = cl.query("db", "s", document_ids=["old"])
+    assert docs and docs[0]["_id"] == "old"
+
+
+def test_rule_validation(rule_cluster):
+    c, cl = rule_cluster
+    with pytest.raises(rpc.RpcError, match="strictly increasing"):
+        make_space(cl, [
+            {"name": "a", "value": (T0 + 2 * DAY_MS) // 1000},
+            {"name": "b", "value": (T0 + 1 * DAY_MS) // 1000},
+        ])
+    with pytest.raises(rpc.RpcError, match="not in"):
+        cl.create_space("db", {
+            "name": "s2", "partition_num": 1,
+            "partition_rule": {"type": "RANGE", "field": "nope",
+                               "ranges": [{"name": "a", "value": 1}]},
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })

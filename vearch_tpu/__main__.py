@@ -14,17 +14,9 @@ import threading
 
 
 def main(argv: list[str] | None = None) -> int:
-    # honor JAX_PLATFORMS through jax.config as well: plugin discovery
-    # for unavailable accelerator platforms can block inside jax init
-    # even when the env var selects cpu (observed with a dead TPU
-    # tunnel); the config route skips the unavailable plugin entirely
-    import os
+    from vearch_tpu.utils import apply_jax_platform_env
 
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
-
-        jax.config.update("jax_platforms", plat)
+    apply_jax_platform_env()
 
     ap = argparse.ArgumentParser(prog="vearch_tpu")
     ap.add_argument("--role", default="standalone",

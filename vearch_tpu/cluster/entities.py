@@ -36,6 +36,9 @@ class Partition:
     # raft leadership epoch: bumped by the master on every failover /
     # membership change; fences deposed leaders (raft.py)
     term: int = 1
+    # partition-rule group this partition belongs to (the range name;
+    # reference: entity/partition.go Partition.Name under PartitionRule)
+    group: str | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return dict(self.__dict__)
@@ -54,9 +57,13 @@ class Space:
     partition_num: int = 1
     replica_num: int = 1
     partitions: list[Partition] = field(default_factory=list)
+    # {"type": "RANGE", "field": ..., "ranges": [{"name", "value"}]} —
+    # ranges ascending; each range backs partition_num slot-sharded
+    # partitions (reference: entity/partition.go:125 PartitionRule)
+    partition_rule: dict | None = None
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "id": self.id,
             "name": self.name,
             "db_name": self.db_name,
@@ -65,6 +72,9 @@ class Space:
             "replica_num": self.replica_num,
             "partitions": [p.to_dict() for p in self.partitions],
         }
+        if self.partition_rule:
+            d["partition_rule"] = self.partition_rule
+        return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Space":
@@ -76,10 +86,67 @@ class Space:
             partition_num=d.get("partition_num", 1),
             replica_num=d.get("replica_num", 1),
             partitions=[Partition.from_dict(p) for p in d.get("partitions", [])],
+            partition_rule=d.get("partition_rule"),
         )
 
     def slot_starts(self) -> list[int]:
         return [p.slot for p in self.partitions]
+
+    # -- partition-rule routing (reference: space.go:198
+    #    PartitionIdsByRangeField — first range whose bound exceeds the
+    #    field value wins) -------------------------------------------------
+
+    def rule_groups(self) -> dict[str, list[Partition]]:
+        groups: dict[str, list[Partition]] = {}
+        for p in self.partitions:
+            groups.setdefault(p.group or "", []).append(p)
+        for parts in groups.values():
+            parts.sort(key=lambda p: p.slot)
+        return groups
+
+    def rule_bounds(self) -> tuple[list[int], list[str]]:
+        """(ascending ns bounds, range names) — normalize the rule once
+        per request, not once per document."""
+        ranges = self.partition_rule["ranges"]
+        return ([rule_value_ns(r["value"]) for r in ranges],
+                [r["name"] for r in ranges])
+
+    def rule_group_for(self, value: Any,
+                       bounds: tuple[list[int], list[str]] | None = None
+                       ) -> str:
+        import bisect
+
+        vals, names = bounds if bounds is not None else self.rule_bounds()
+        i = bisect.bisect_right(vals, rule_value_ns(value))
+        if i >= len(names):
+            raise ValueError(
+                f"no partition range covers "
+                f"{self.partition_rule['field']}={value!r} "
+                f"(ranges are exclusive upper bounds)"
+            )
+        return names[i]
+
+
+def rule_value_ns(value: Any) -> int:
+    """Normalize a partition-rule value to nanoseconds (reference:
+    partition.go ToTimestamp — ints are seconds, strings parse as
+    dates). Document DATE fields arrive as epoch millis."""
+    if isinstance(value, bool):
+        raise ValueError("bool is not a date")
+    if isinstance(value, (int, float)):
+        v = int(value)
+        # heuristically scale: ns > 1e16, ms > 1e11, else seconds
+        if v > 10**16:
+            return v
+        if v > 10**11:
+            return v * 1_000_000
+        return v * 1_000_000_000
+    from datetime import datetime, timezone
+
+    dt = datetime.fromisoformat(str(value))
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return int(dt.timestamp() * 1e9)
 
 
 @dataclass

@@ -99,6 +99,7 @@ class MasterServer:
         s.route("DELETE", "/dbs", self._h_delete_db)
         s.route("GET", "/partitions", self._h_partitions)
         s.route("POST", "/partitions/change_member", self._h_change_member)
+        s.route("POST", "/partitions/rule", self._h_partition_rule)
         s.route("POST", "/config", self._h_set_config)
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)
@@ -650,48 +651,148 @@ class MasterServer:
                     400,
                     f"replica_num {replica_num} > {len(servers)} servers",
                 )
+            rule = body.get("partition_rule")
+            if rule is not None:
+                self._validate_rule(rule, schema)
             space_id = self.store.next_id(SEQ_SPACE_ID)
-            slots = carve_slots(partition_num)
             space = Space(
                 id=space_id, name=name, db_name=db, schema=schema,
                 partition_num=partition_num, replica_num=replica_num,
+                partition_rule=rule,
             )
-            # round-robin placement with replica anti-affinity by node
-            # (reference: space_service.go:141-149 + replica placement)
-            for i in range(partition_num):
-                pid = self.store.next_id(SEQ_PARTITION_ID)
-                replicas = [
-                    servers[(i + r) % len(servers)].node_id
-                    for r in range(replica_num)
-                ]
-                part = Partition(
-                    id=pid, space_id=space_id, db_name=db, space_name=name,
-                    slot=slots[i], replicas=replicas, leader=replicas[0],
-                )
-                for node_id in replicas:
-                    srv = next(s for s in servers if s.node_id == node_id)
-                    rpc.call(srv.rpc_addr, "POST", "/ps/partition/create", {
-                        "partition": part.to_dict(),
-                        "schema": schema.to_dict(),
-                    })
-                    srv.partition_ids.append(pid)
-                    self.store.put(f"{PREFIX_SERVER}{node_id}", srv.to_dict())
-                space.partitions.append(part)
+            # with a partition rule, every range backs its own group of
+            # partition_num slot-sharded partitions (reference: a 3-range
+            # rule with partition_num=2 yields 6 partitions)
+            groups = [r["name"] for r in rule["ranges"]] if rule else [None]
+            for group in groups:
+                self._create_partition_group(space, servers, group)
             self.store.put(key, space.to_dict())
             return space.to_dict()
         finally:
             self.store.unlock("space_create", f"{db}/{name}")
 
-    def _delete_space(self, db: str, name: str) -> dict:
+    def _validate_rule(self, rule: dict, schema: TableSchema) -> None:
+        from vearch_tpu.cluster.entities import rule_value_ns
+
+        if rule.get("type") != "RANGE":
+            raise RpcError(400, "only partition rule type RANGE supported")
+        fname = rule.get("field", "")
+        fields = {f.name for f in schema.scalar_fields()}
+        if fname not in fields:
+            raise RpcError(400, f"partition rule field {fname!r} not in "
+                                f"space fields")
+        ranges = rule.get("ranges") or []
+        if not ranges:
+            raise RpcError(400, "empty partition rule ranges")
+        names = [r.get("name") for r in ranges]
+        if len(set(names)) != len(names) or not all(names):
+            raise RpcError(400, f"range names must be unique/non-empty: "
+                                f"{names}")
+        try:
+            vals = [rule_value_ns(r["value"]) for r in ranges]
+        except (ValueError, KeyError) as e:
+            raise RpcError(400, f"bad range value: {e}") from e
+        if vals != sorted(vals) or len(set(vals)) != len(vals):
+            raise RpcError(400, "range values must be strictly increasing")
+
+    def _create_partition_group(self, space: Space, servers, group) -> None:
+        """Create one group of partition_num slot-sharded partitions with
+        round-robin replica placement (reference: space_service.go:141-149)."""
+        slots = carve_slots(space.partition_num)
+        offset = len(space.partitions)
+        for i in range(space.partition_num):
+            pid = self.store.next_id(SEQ_PARTITION_ID)
+            replicas = [
+                servers[(offset + i + r) % len(servers)].node_id
+                for r in range(space.replica_num)
+            ]
+            part = Partition(
+                id=pid, space_id=space.id, db_name=space.db_name,
+                space_name=space.name, slot=slots[i], replicas=replicas,
+                leader=replicas[0], group=group,
+            )
+            for node_id in replicas:
+                srv = next(s for s in servers if s.node_id == node_id)
+                rpc.call(srv.rpc_addr, "POST", "/ps/partition/create", {
+                    "partition": part.to_dict(),
+                    "schema": space.schema.to_dict(),
+                })
+                srv.partition_ids.append(pid)
+                self.store.put(f"{PREFIX_SERVER}{node_id}", srv.to_dict())
+            space.partitions.append(part)
+
+    def _h_partition_rule(self, body: dict, _parts) -> dict:
+        """Online add/drop of rule partitions (reference:
+        test_module_partition.py:268 update_space_partition_rule with
+        operator_type ADD/DROP)."""
+        from vearch_tpu.cluster.entities import rule_value_ns
+
+        db, name = body["db_name"], body["space_name"]
         key = f"{PREFIX_SPACE}{db}/{name}"
+        # same lock as space create: concurrent ADD/DROP (or a racing
+        # space delete) would read-modify-write over each other
+        if not self.store.try_lock("space_create", f"{db}/{name}"):
+            raise RpcError(409, "space mutation in progress")
+        try:
+            return self._partition_rule_locked(body, db, name, key)
+        finally:
+            self.store.unlock("space_create", f"{db}/{name}")
+
+    def _partition_rule_locked(self, body, db, name, key) -> dict:
+        from vearch_tpu.cluster.entities import rule_value_ns
+
         sp = self.store.get(key)
         if sp is None:
             raise RpcError(404, f"space {db}/{name} not found")
         space = Space.from_dict(sp)
-        servers = {s.node_id: s for s in self._alive_servers()}
-        for part in space.partitions:
+        if not space.partition_rule:
+            raise RpcError(400, f"space {db}/{name} has no partition rule")
+        op = body.get("operator_type", "ADD").upper()
+        servers = self._alive_servers()
+        if op == "DROP":
+            pname = body["partition_name"]
+            ranges = space.partition_rule["ranges"]
+            if pname not in {r["name"] for r in ranges}:
+                raise RpcError(404, f"rule partition {pname!r} not found")
+            space.partition_rule["ranges"] = [
+                r for r in ranges if r["name"] != pname
+            ]
+            doomed = [p for p in space.partitions if p.group == pname]
+            space.partitions = [
+                p for p in space.partitions if p.group != pname
+            ]
+            self._drop_partitions(doomed, servers)
+        elif op == "ADD":
+            new_ranges = (body.get("partition_rule") or {}).get("ranges", [])
+            if not new_ranges:
+                raise RpcError(400, "ADD requires partition_rule.ranges")
+            if len(servers) < max(space.replica_num, 1):
+                raise RpcError(
+                    503,
+                    f"need {space.replica_num} alive servers for new "
+                    f"partitions, have {len(servers)}",
+                )
+            merged = space.partition_rule["ranges"] + list(new_ranges)
+            merged.sort(key=lambda r: rule_value_ns(r["value"]))
+            probe = {**space.partition_rule, "ranges": merged}
+            self._validate_rule(probe, space.schema)
+            space.partition_rule = probe
+            for r in new_ranges:
+                self._create_partition_group(space, servers, r["name"])
+        else:
+            raise RpcError(400, f"unknown operator_type {op!r}")
+        self.store.put(key, space.to_dict())
+        return space.to_dict()
+
+    def _drop_partitions(self, parts: list[Partition], servers) -> None:
+        """Delete partitions on their replicas and trim the ids from the
+        server records (a stale partition_ids list would skew the
+        least-loaded placement metric forever under retention churn)."""
+        by_id = {s.node_id: s for s in servers}
+        touched = set()
+        for part in parts:
             for node_id in part.replicas:
-                srv = servers.get(node_id)
+                srv = by_id.get(node_id)
                 if srv is None:
                     continue
                 try:
@@ -699,5 +800,19 @@ class MasterServer:
                              {"partition_id": part.id})
                 except RpcError:
                     pass
+                if part.id in srv.partition_ids:
+                    srv.partition_ids.remove(part.id)
+                    touched.add(node_id)
+        for node_id in touched:
+            self.store.put(f"{PREFIX_SERVER}{node_id}",
+                           by_id[node_id].to_dict())
+
+    def _delete_space(self, db: str, name: str) -> dict:
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        sp = self.store.get(key)
+        if sp is None:
+            raise RpcError(404, f"space {db}/{name} not found")
+        space = Space.from_dict(sp)
+        self._drop_partitions(space.partitions, self._alive_servers())
         self.store.delete(key)
         return {"name": name}

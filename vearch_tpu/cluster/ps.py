@@ -50,6 +50,9 @@ class PSServer:
         flush_interval: float = 5.0,
         raft_tick: float = 0.4,
     ):
+        from vearch_tpu.utils import apply_jax_platform_env
+
+        apply_jax_platform_env()  # before any engine touches jax
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.engines: dict[int, Engine] = {}

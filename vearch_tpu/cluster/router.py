@@ -61,6 +61,7 @@ class RouterServer:
         for method in ("GET", "POST", "DELETE"):
             s.route(method, "/alias", self._proxy_master(method, "/alias"))
         s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
+        s.route("POST", "/partitions/rule", self._h_partition_rule)
         s.route("GET", "/cluster/health", self._h_health)
 
     def start(self) -> None:
@@ -209,6 +210,13 @@ class RouterServer:
     def _h_health(self, _body, _parts) -> dict:
         return self._master_call("GET", "/")
 
+    def _h_partition_rule(self, body, _parts) -> dict:
+        out = self._master_call("POST", "/partitions/rule", body)
+        # topology changed (groups added/dropped): serving from the TTL
+        # cache would fan out to deleted partitions
+        self._invalidate_caches()
+        return out
+
     # -- document routes -----------------------------------------------------
 
     def _partition_of_keys(self, space: Space, keys: list[str]) -> list[int]:
@@ -233,10 +241,44 @@ class RouterServer:
             doc if "_id" in doc else {**doc, "_id": uuid.uuid4().hex}
             for doc in docs
         ]
+        if space.partition_rule:
+            return self._route_docs_by_rule(space, docs)
         pids = self._partition_of_keys(space, [str(d["_id"]) for d in docs])
         by_partition: dict[int, list[dict]] = {}
         for doc, pid in zip(docs, pids):
             by_partition.setdefault(pid, []).append(doc)
+        return by_partition
+
+    def _route_docs_by_rule(
+        self, space: Space, docs: list[dict]
+    ) -> dict[int, list[dict]]:
+        """Range-rule routing: the rule field picks the range group, the
+        murmur3(_id) slot picks the partition within the group
+        (reference: space.go:198 PartitionIdsByRangeField + slot)."""
+        import numpy as np
+
+        from vearch_tpu import native
+        from vearch_tpu.cluster.hashing import partition_for_slot
+
+        field = space.partition_rule["field"]
+        groups = space.rule_groups()
+        bounds = space.rule_bounds()  # normalized once per request
+        by_partition: dict[int, list[dict]] = {}
+        slots = native.murmur3_batch([str(d["_id"]) for d in docs])
+        for doc, slot in zip(docs, np.asarray(slots).tolist()):
+            value = doc.get(field)
+            if value is None:
+                raise RpcError(
+                    400, f"partition rule field {field!r} missing in doc "
+                         f"{doc.get('_id')!r}"
+                )
+            try:
+                gname = space.rule_group_for(value, bounds)
+            except ValueError as e:
+                raise RpcError(400, str(e)) from e
+            parts = groups[gname]
+            idx = partition_for_slot([p.slot for p in parts], int(slot))
+            by_partition.setdefault(parts[idx].id, []).append(doc)
         return by_partition
 
     def _h_upsert(self, body: dict, _parts) -> dict:
@@ -379,9 +421,15 @@ class RouterServer:
         space = self._space(*skey)
         if body.get("document_ids"):
             keys_in = [str(k) for k in body["document_ids"]]
-            by_partition: dict[int, list[str]] = {}
-            for key, pid in zip(keys_in, self._partition_of_keys(space, keys_in)):
-                by_partition.setdefault(pid, []).append(key)
+            # under a partition rule the owning partition depends on the
+            # rule field, not the key: fan the lookup to every partition
+            if space.partition_rule:
+                by_partition = {p.id: keys_in for p in space.partitions}
+            else:
+                by_partition: dict[int, list[str]] = {}
+                for key, pid in zip(keys_in,
+                                    self._partition_of_keys(space, keys_in)):
+                    by_partition.setdefault(pid, []).append(key)
 
             lb = body.get("load_balance", "leader")
 
@@ -396,8 +444,12 @@ class RouterServer:
                 for pid, keys in by_partition.items()
             ]
             docs: list[dict] = []
+            seen: set[str] = set()
             for f in futures:
-                docs.extend(f.result()["documents"])
+                for d in f.result()["documents"]:
+                    if d["_id"] not in seen:
+                        seen.add(d["_id"])
+                        docs.append(d)
             return {"total": len(docs), "documents": docs}
 
         limit = int(body.get("limit", 50))
@@ -430,9 +482,13 @@ class RouterServer:
         space = self._space(*skey)
         if body.get("document_ids"):
             keys_in = [str(k) for k in body["document_ids"]]
-            by_partition: dict[int, list[str]] = {}
-            for key, pid in zip(keys_in, self._partition_of_keys(space, keys_in)):
-                by_partition.setdefault(pid, []).append(key)
+            if space.partition_rule:
+                by_partition = {p.id: keys_in for p in space.partitions}
+            else:
+                by_partition: dict[int, list[str]] = {}
+                for key, pid in zip(keys_in,
+                                    self._partition_of_keys(space, keys_in)):
+                    by_partition.setdefault(pid, []).append(key)
 
             def send(pid: int, keys: list[str]):
                 return self._call_partition(skey, pid, "/ps/doc/delete",

@@ -1,0 +1,23 @@
+import os
+
+
+def apply_jax_platform_env() -> None:
+    """Propagate JAX_PLATFORMS into jax.config before backend init.
+
+    Plugin discovery for an unavailable accelerator platform can block
+    inside jax initialisation even when the env var selects cpu
+    (observed with a dead TPU tunnel); the config route skips the
+    unavailable plugin entirely. No-op when jax already initialised a
+    backend or the env var is unset.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax  # a broken jax install must fail loudly, not hang later
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except RuntimeError:
+        # backend already initialised: the config is frozen, which also
+        # means plugin discovery already happened — nothing to prevent
+        pass
