@@ -549,21 +549,25 @@ class MasterServer:
             raise RpcError(404, f"space {db}/{name} not found")
         space = Space.from_dict(sp)
         command = body.get("command", "create")
-        store_root = body["store_root"]
+        # `store` spec selects the backend (local root or s3 —
+        # reference: minio-configured PSShardManager); legacy
+        # `store_root` remains the local-filesystem shorthand
+        store_spec = body.get("store") or body["store_root"]
+        from vearch_tpu.cluster.objectstore import make_object_store
+
+        ostore = make_object_store(store_spec)
         servers = {s.node_id: s for s in self._alive_servers()}
         base_prefix = f"backup/{db}/{name}"
 
         import json as _json
-        import os as _os
+        import re as _re
 
         if command == "create":
             version = self.store.next_id(f"/seq/backup/{db}/{name}")
             prefix = f"{base_prefix}/v{version}"
             # space metadata rides with the backup for cross-cluster restore
-            meta_dir = _os.path.join(store_root, prefix)
-            _os.makedirs(meta_dir, exist_ok=True)
-            with open(_os.path.join(meta_dir, "space.json"), "w") as f:
-                _json.dump(space.to_dict(), f)
+            ostore.put_bytes(f"{prefix}/space.json",
+                             _json.dumps(space.to_dict()).encode())
             results = []
             for i, part in enumerate(sorted(space.partitions,
                                             key=lambda p: p.slot)):
@@ -572,27 +576,30 @@ class MasterServer:
                     raise RpcError(503, f"leader of partition {part.id} down")
                 results.append(rpc.call(srv.rpc_addr, "POST", "/ps/backup", {
                     "partition_id": part.id,
-                    "store_root": store_root,
+                    "store_root": body.get("store_root"),
+                    "store": body.get("store"),
                     "key_prefix": f"{prefix}/shard_{i}",
                 }))
             return {"version": version, "partitions": results}
 
         if command == "list":
-            root = _os.path.join(store_root, base_prefix)
-            versions = sorted(
-                int(d[1:]) for d in _os.listdir(root)
-                if d.startswith("v")
-            ) if _os.path.isdir(root) else []
+            versions = sorted({
+                int(m.group(1))
+                for k in ostore.list(base_prefix)
+                if (m := _re.search(rf"{_re.escape(base_prefix)}/v(\d+)/", k))
+            })
             return {"versions": versions}
 
         if command == "restore":
             version = int(body["version"])
             prefix = f"{base_prefix}/v{version}"
-            meta_path = _os.path.join(store_root, prefix, "space.json")
-            if not _os.path.isfile(meta_path):
-                raise RpcError(404, f"backup v{version} not found")
-            with open(meta_path) as f:
-                bmeta = _json.load(f)
+            try:
+                bmeta = _json.loads(ostore.get_bytes(f"{prefix}/space.json"))
+            except FileNotFoundError as e:
+                raise RpcError(404, f"backup v{version} not found") from e
+            except IOError as e:
+                # transient store trouble is NOT "backup not found"
+                raise RpcError(503, f"backup store error: {e}") from e
             if len(bmeta["partitions"]) != len(space.partitions):
                 raise RpcError(
                     400,
@@ -614,7 +621,8 @@ class MasterServer:
                         continue
                     res = rpc.call(srv.rpc_addr, "POST", "/ps/restore", {
                         "partition_id": part.id,
-                        "store_root": store_root,
+                        "store_root": body.get("store_root"),
+                        "store": body.get("store"),
                         "key_prefix": f"{prefix}/shard_{i}",
                     })
                     if r == part.leader:

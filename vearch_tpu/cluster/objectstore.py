@@ -1,18 +1,29 @@
 """Pluggable object store for backup/restore.
 
 The reference backs up shards to S3/MinIO (reference:
-ps/backup/ps_backup_service.go:14,67 minio client; versioned layout with
-ref-counted files). The interface here is S3-shaped (put/get/list by key);
-`LocalObjectStore` is the in-tree backend (shared filesystem / NFS), and
-an S3 backend can implement the same three methods against any client
-without touching the backup service (this image is zero-egress, so no S3
-SDK is vendored — see docs/PARITY.md).
+ps/backup/ps_backup_service.go:14,67 minio client; versioned layout).
+Two backends behind one interface:
+
+- `LocalObjectStore` — shared filesystem / NFS;
+- `S3ObjectStore` — stdlib-only S3 client (AWS Signature V4 over
+  http.client; works against AWS S3 and MinIO). No SDK: the image is
+  zero-egress, and the wire protocol is small enough that the four
+  operations the backup service needs (PUT/GET object, ListObjectsV2)
+  fit in ~100 lines.
+
+Integrity: `put_tree` writes a MANIFEST with per-file CRC32s;
+`get_tree` verifies every file against it and fails loudly on mismatch
+(reference: ps/backup CRC32 checks).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
+import zlib
+
+MANIFEST = "MANIFEST.json"
 
 
 def is_within(root: str, path: str) -> bool:
@@ -24,14 +35,117 @@ def is_within(root: str, path: str) -> bool:
 
 
 class ObjectStore:
-    def put_file(self, key: str, local_path: str) -> None:
+    def put_bytes(self, key: str, data: bytes) -> None:
         raise NotImplementedError
 
-    def get_file(self, key: str, local_path: str) -> None:
+    def get_bytes(self, key: str) -> bytes:
         raise NotImplementedError
 
     def list(self, prefix: str) -> list[str]:
         raise NotImplementedError
+
+    def put_file(self, key: str, local_path: str) -> None:
+        with open(local_path, "rb") as f:
+            self.put_bytes(key, f.read())
+
+    def get_file(self, key: str, local_path: str) -> None:
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(self.get_bytes(key))
+
+    # -- tree transfer with CRC32 manifest (reference: ps/backup crc
+    #    integrity + ref-counted shard files) ------------------------------
+
+    def put_tree(self, key_prefix: str, local_dir: str) -> int:
+        """Upload a directory tree. The manifest (per-file CRC32 + size,
+        streamed, never whole-file in memory) is written FIRST: a backup
+        interrupted mid-upload then fails restore loudly as incomplete,
+        instead of masquerading as a smaller complete one."""
+        manifest: dict[str, dict] = {}
+        paths: list[tuple[str, str]] = []
+        for dirpath, _dirs, files in os.walk(local_dir):
+            for fname in files:
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, local_dir).replace(os.sep, "/")
+                manifest[rel] = {"crc32": _crc_file(full),
+                                 "size": os.path.getsize(full)}
+                paths.append((rel, full))
+        self.put_bytes(f"{key_prefix}/{MANIFEST}",
+                       json.dumps(manifest).encode())
+        for rel, full in paths:
+            self.put_file(f"{key_prefix}/{rel}", full)
+        return len(paths)
+
+    def get_tree(self, key_prefix: str, local_dir: str) -> int:
+        """Restore a tree, verifying every file's CRC32 against the
+        manifest (required); corrupt, missing, or path-escaping entries
+        abort the restore rather than quietly loading damaged state."""
+        try:
+            manifest = json.loads(
+                self.get_bytes(f"{key_prefix}/{MANIFEST}")
+            )
+        except (KeyError, FileNotFoundError) as e:
+            raise IOError(
+                f"backup at {key_prefix!r} has no manifest (incomplete "
+                f"or interrupted backup)"
+            ) from e
+        pfx = key_prefix.rstrip("/") + "/"  # exact dir, not shard_1 ~ shard_10
+        os.makedirs(local_dir, exist_ok=True)
+        n = 0
+        restored = set()
+        for key in self.list(pfx):
+            rel = key[len(pfx):] if key.startswith(pfx) else key
+            if rel == MANIFEST:
+                continue
+            dst = os.path.join(local_dir, rel)
+            # a hostile/corrupt store must not write outside local_dir
+            if os.path.isabs(rel) or not is_within(local_dir, dst):
+                raise IOError(f"backup key escapes restore dir: {rel!r}")
+            meta = manifest.get(rel)
+            if meta is None:
+                raise IOError(f"backup file {rel!r} not in manifest")
+            self.get_file(key, dst)
+            if _crc_file(dst) != meta["crc32"] or \
+                    os.path.getsize(dst) != meta["size"]:
+                raise IOError(
+                    f"backup integrity check failed for {rel!r}: "
+                    f"crc/size mismatch"
+                )
+            restored.add(rel)
+            n += 1
+        missing = set(manifest) - restored
+        if missing:
+            raise IOError(f"backup incomplete: missing {sorted(missing)}")
+        return n
+
+
+def _crc_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def make_object_store(spec: dict | str) -> "ObjectStore":
+    """Factory from a backup request's store spec: a plain string is a
+    local root; {"type": "s3", ...} builds the S3 backend."""
+    if isinstance(spec, str):
+        return LocalObjectStore(spec)
+    t = spec.get("type", "local")
+    if t == "local":
+        return LocalObjectStore(spec["root"])
+    if t == "s3":
+        return S3ObjectStore(
+            endpoint=spec["endpoint"], bucket=spec["bucket"],
+            access_key=spec.get("access_key", ""),
+            secret_key=spec.get("secret_key", ""),
+            region=spec.get("region", "us-east-1"),
+            prefix=spec.get("prefix", ""),
+        )
+    raise ValueError(f"unknown object store type {t!r}")
 
 
 class LocalObjectStore(ObjectStore):
@@ -47,14 +161,22 @@ class LocalObjectStore(ObjectStore):
             raise ValueError(f"key escapes store root: {key}")
         return path
 
+    def put_bytes(self, key: str, data: bytes) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dst)
+
+    def get_bytes(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
     def put_file(self, key: str, local_path: str) -> None:
         dst = self._path(key)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         shutil.copyfile(local_path, dst)
-
-    def get_file(self, key: str, local_path: str) -> None:
-        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-        shutil.copyfile(self._path(key), local_path)
 
     def list(self, prefix: str) -> list[str]:
         base = self._path(prefix)
@@ -62,23 +184,176 @@ class LocalObjectStore(ObjectStore):
         for dirpath, _dirs, files in os.walk(base):
             for f in files:
                 full = os.path.join(dirpath, f)
-                out.append(os.path.relpath(full, self.root))
+                out.append(
+                    os.path.relpath(full, self.root).replace(os.sep, "/")
+                )
         return sorted(out)
 
-    def put_tree(self, key_prefix: str, local_dir: str) -> int:
-        n = 0
-        for dirpath, _dirs, files in os.walk(local_dir):
-            for f in files:
-                full = os.path.join(dirpath, f)
-                rel = os.path.relpath(full, local_dir)
-                self.put_file(f"{key_prefix}/{rel}", full)
-                n += 1
-        return n
 
-    def get_tree(self, key_prefix: str, local_dir: str) -> int:
-        n = 0
-        for key in self.list(key_prefix):
-            rel = os.path.relpath(key, key_prefix)
-            self.get_file(key, os.path.join(local_dir, rel))
-            n += 1
-        return n
+class S3ObjectStore(ObjectStore):
+    """Minimal S3 client: PUT/GET object + ListObjectsV2 with AWS
+    Signature V4 (reference: ps/backup uses the minio client for the
+    same three calls). Stdlib only; path-style addressing so MinIO
+    works out of the box."""
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1",
+                 prefix: str = ""):
+        # endpoint: "host:port" or "http(s)://host:port"
+        self.secure = endpoint.startswith("https://")
+        self.host = endpoint.split("://", 1)[-1].rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    # -- SigV4 (AWS Signature Version 4, the public spec) ----------------
+
+    def _sign(self, method: str, path: str, query: str, payload_hash: str
+              ) -> dict:
+        import datetime
+        import hashlib
+        import hmac
+        from urllib.parse import quote
+
+        t = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = t.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = t.strftime("%Y%m%d")
+        headers = {
+            "host": self.host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        signed = ";".join(sorted(headers))
+        # SigV4 canonicalises query params SORTED by name — real S3
+        # rejects construction order (SignatureDoesNotMatch)
+        canonical_query = "&".join(sorted(query.split("&"))) if query else ""
+        canonical = "\n".join([
+            method, quote(path), canonical_query,
+            "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+            signed, payload_hash,
+        ])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+
+        def hm(key: bytes, msg: str) -> bytes:
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = hm(("AWS4" + self.secret_key).encode(), datestamp)
+        k = hm(hm(hm(k, self.region), "s3"), "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={sig}"
+        )
+        return headers
+
+    def _request(self, method: str, key: str = "", query: str = "",
+                 payload: bytes = b"", body_path: str | None = None,
+                 stream_to: str | None = None) -> bytes:
+        """One signed S3 call. body_path streams the request body from
+        disk (two-pass: sha256 then send); stream_to writes the response
+        to disk in chunks — multi-GB shard files never sit in memory."""
+        import hashlib
+        import http.client
+        from urllib.parse import quote
+
+        path = f"/{self.bucket}"
+        if key:
+            path += f"/{key}"
+        if body_path is not None:
+            h = hashlib.sha256()
+            size = 0
+            with open(body_path, "rb") as f:
+                while True:
+                    buf = f.read(1 << 20)
+                    if not buf:
+                        break
+                    h.update(buf)
+                    size += len(buf)
+            payload_hash = h.hexdigest()
+        else:
+            payload_hash = hashlib.sha256(payload).hexdigest()
+        headers = self._sign(method, path, query, payload_hash)
+        cls = http.client.HTTPSConnection if self.secure \
+            else http.client.HTTPConnection
+        conn = cls(self.host, timeout=60)
+        try:
+            url = quote(path) + (f"?{query}" if query else "")
+            if body_path is not None:
+                headers["Content-Length"] = str(size)
+                with open(body_path, "rb") as f:
+                    conn.request(method, url, body=f, headers=headers)
+            else:
+                conn.request(method, url, body=payload or None,
+                             headers=headers)
+            resp = conn.getresponse()
+            if resp.status == 404:
+                resp.read()
+                raise FileNotFoundError(f"s3://{self.bucket}/{key}")
+            if resp.status >= 300:
+                body = resp.read()
+                raise IOError(
+                    f"S3 {method} {path}: {resp.status} {body[:200]!r}"
+                )
+            if stream_to is not None:
+                os.makedirs(os.path.dirname(stream_to) or ".",
+                            exist_ok=True)
+                with open(stream_to, "wb") as out:
+                    while True:
+                        buf = resp.read(1 << 20)
+                        if not buf:
+                            break
+                        out.write(buf)
+                return b""
+            return resp.read()
+        finally:
+            conn.close()
+
+    # -- ObjectStore interface -------------------------------------------
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._request("PUT", self._key(key), payload=data)
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._request("GET", self._key(key))
+
+    def put_file(self, key: str, local_path: str) -> None:
+        self._request("PUT", self._key(key), body_path=local_path)
+
+    def get_file(self, key: str, local_path: str) -> None:
+        self._request("GET", self._key(key), stream_to=local_path)
+
+    def list(self, prefix: str) -> list[str]:
+        import re
+        from urllib.parse import quote
+
+        full_prefix = self._key(prefix)
+        out: list[str] = []
+        token = ""
+        while True:
+            query = f"list-type=2&prefix={quote(full_prefix, safe='')}"
+            if token:
+                query += f"&continuation-token={quote(token, safe='')}"
+            body = self._request("GET", "", query=query).decode()
+            out.extend(re.findall(r"<Key>([^<]+)</Key>", body))
+            m = re.search(
+                r"<NextContinuationToken>([^<]+)</NextContinuationToken>",
+                body,
+            )
+            if not m:
+                break
+            token = m.group(1)
+        strip = (self.prefix + "/") if self.prefix else ""
+        return sorted(
+            k[len(strip):] if strip and k.startswith(strip) else k
+            for k in out
+        )

@@ -47,6 +47,7 @@ class PSServer:
         memory_limit_mb: int = 0,
         master_auth: tuple[str, str] | None = None,
         backup_roots: list[str] | None = None,
+        backup_endpoints: list[str] | None = None,
         flush_interval: float = 5.0,
         raft_tick: float = 0.4,
     ):
@@ -87,6 +88,11 @@ class PSServer:
             [os.path.abspath(r) for r in backup_roots] if backup_roots
             else None
         )
+        # s3 counterpart of backup_roots: allowed endpoint hosts. When
+        # EITHER allowlist is configured, the other destination type is
+        # default-denied — a confined operator setup must not be
+        # escapable by just switching store types (exfiltration/SSRF)
+        self.backup_endpoints = backup_endpoints
         self.replication_errors = 0  # surfaced in /ps/stats
         self._peer_cache: tuple[float, dict[int, str]] = (0.0, {})
 
@@ -633,58 +639,79 @@ class PSServer:
     # -- backup/restore (reference: ps/backup/ps_backup_service.go:77
     #    PSShardManager — shard dump streamed to object storage) -------------
 
-    def _check_backup_root(self, store_root: str) -> None:
-        from vearch_tpu.cluster.objectstore import is_within
+    def _backup_store(self, body: dict):
+        """Resolve the object store from the request: legacy store_root
+        strings stay local-filesystem; a `store` spec may select s3
+        (reference: minio client configured from master config). The
+        operator allowlists gate BOTH destination types."""
+        from vearch_tpu.cluster.objectstore import is_within, make_object_store
 
-        if self.backup_roots is None:
-            return
-        if any(is_within(allowed, store_root)
-               for allowed in self.backup_roots):
-            return
-        raise RpcError(403, f"store_root {store_root!r} not in the "
-                            f"operator backup_roots allowlist")
+        confined = (self.backup_roots is not None
+                    or self.backup_endpoints is not None)
+        spec = body.get("store") or body["store_root"]
+        if isinstance(spec, str) or spec.get("type", "local") == "local":
+            root = spec if isinstance(spec, str) else spec["root"]
+            if confined and not any(
+                is_within(allowed, root)
+                for allowed in (self.backup_roots or [])
+            ):
+                raise RpcError(403, f"store_root {root!r} not in the "
+                                    f"operator backup_roots allowlist")
+        else:
+            host = str(spec.get("endpoint", "")).split("://", 1)[-1]
+            if confined and host not in (self.backup_endpoints or []):
+                raise RpcError(
+                    403, f"s3 endpoint {host!r} not in the operator "
+                         f"backup_endpoints allowlist"
+                )
+        return make_object_store(spec)
 
     def _h_backup(self, body: dict, _parts) -> dict:
         import tempfile
 
-        from vearch_tpu.cluster.objectstore import LocalObjectStore
-
         pid = int(body["partition_id"])
         eng = self._engine(pid)
-        self._check_backup_root(body["store_root"])
-        store = LocalObjectStore(body["store_root"])
+        store = self._backup_store(body)
         with tempfile.TemporaryDirectory() as tmp:
             eng.dump(tmp)
             n = store.put_tree(body["key_prefix"], tmp)
         return {"partition_id": pid, "files": n}
 
     def _h_restore(self, body: dict, _parts) -> dict:
-        from vearch_tpu.cluster.objectstore import LocalObjectStore
-
         pid = int(body["partition_id"])
         eng = self._engine(pid)  # partition must exist (space created first)
         node = self._node(pid)
-        self._check_backup_root(body["store_root"])
-        store = LocalObjectStore(body["store_root"])
+        store = self._backup_store(body)
         data_dir = os.path.join(self.data_dir, f"partition_{pid}")
-        with node._apply_lock:
-            eng.close()
-            for name in list(os.listdir(data_dir)):
-                if name in ("raft", "partition.json"):
-                    continue
-                p = os.path.join(data_dir, name)
-                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
-            n = store.get_tree(body["key_prefix"], data_dir)
-            restored = Engine.open(data_dir)
-            restored.start_refresh_loop()
-            with self._lock:
-                self.engines[pid] = restored
-            # restored state supersedes the log: reset it at the current
-            # applied horizon (a restore is a point-in-time rewind)
-            node.wal.reset(node.wal.last_index + 1)
-            node.applied = node.wal.last_index
-            node.wal.commit_index = node.wal.last_index
-            node.wal.save_meta(fsync=True)
+        # download + CRC-verify into a staging dir FIRST: a network
+        # failure or integrity error must leave the live partition
+        # untouched, not bricked with a wiped directory
+        stage = data_dir + ".restore"
+        shutil.rmtree(stage, ignore_errors=True)
+        try:
+            n = store.get_tree(body["key_prefix"], stage)
+            with node._apply_lock:
+                eng.close()
+                for name in list(os.listdir(data_dir)):
+                    if name in ("raft", "partition.json"):
+                        continue
+                    p = os.path.join(data_dir, name)
+                    shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+                for name in os.listdir(stage):
+                    os.replace(os.path.join(stage, name),
+                               os.path.join(data_dir, name))
+                restored = Engine.open(data_dir)
+                restored.start_refresh_loop()
+                with self._lock:
+                    self.engines[pid] = restored
+                # restored state supersedes the log: reset it at the
+                # current applied horizon (a point-in-time rewind)
+                node.wal.reset(node.wal.last_index + 1)
+                node.applied = node.wal.last_index
+                node.wal.commit_index = node.wal.last_index
+                node.wal.save_meta(fsync=True)
+        finally:
+            shutil.rmtree(stage, ignore_errors=True)
         return {"partition_id": pid, "files": n,
                 "doc_count": restored.doc_count}
 
