@@ -1,0 +1,330 @@
+// Package vearchtpu is the Go client SDK for the vearch-tpu cluster.
+//
+// It speaks the router/master REST surface (route names mirror upstream
+// vearch, reference: sdk/go/vearch_client.go public surface), stdlib
+// only. All document operations go through a router address; admin
+// operations are proxied by the router to the master.
+//
+// NOTE: this environment ships no Go toolchain, so this package is
+// written to be vet-clean but is compile-verified by consumers, not CI
+// here (see docs/PARITY.md).
+package vearchtpu
+
+import (
+	"bytes"
+	"encoding/base64"
+	"encoding/json"
+	"fmt"
+	"io"
+	"net/http"
+	"time"
+)
+
+// Client talks to a vearch-tpu router (documents) and, through its
+// master proxy, the control plane.
+type Client struct {
+	RouterURL string // e.g. "http://127.0.0.1:8817"
+	Username  string // optional BasicAuth
+	Password  string
+	HTTP      *http.Client
+}
+
+// New creates a client with a 120s default timeout.
+func New(routerURL string) *Client {
+	return &Client{
+		RouterURL: routerURL,
+		HTTP:      &http.Client{Timeout: 120 * time.Second},
+	}
+}
+
+// WithAuth sets BasicAuth credentials for every request.
+func (c *Client) WithAuth(user, password string) *Client {
+	c.Username, c.Password = user, password
+	return c
+}
+
+// APIError carries the server's error code and message.
+type APIError struct {
+	Code int    `json:"code"`
+	Msg  string `json:"msg"`
+}
+
+func (e *APIError) Error() string {
+	return fmt.Sprintf("vearch-tpu: code=%d msg=%s", e.Code, e.Msg)
+}
+
+type envelope struct {
+	Code int             `json:"code"`
+	Msg  string          `json:"msg"`
+	Data json.RawMessage `json:"data"`
+}
+
+func (c *Client) do(method, path string, body any, out any) error {
+	var rd io.Reader
+	if body != nil {
+		raw, err := json.Marshal(body)
+		if err != nil {
+			return err
+		}
+		rd = bytes.NewReader(raw)
+	}
+	req, err := http.NewRequest(method, c.RouterURL+path, rd)
+	if err != nil {
+		return err
+	}
+	req.Header.Set("Content-Type", "application/json")
+	if c.Username != "" {
+		tok := base64.StdEncoding.EncodeToString(
+			[]byte(c.Username + ":" + c.Password))
+		req.Header.Set("Authorization", "Basic "+tok)
+	}
+	resp, err := c.HTTP.Do(req)
+	if err != nil {
+		return err
+	}
+	defer resp.Body.Close()
+	raw, err := io.ReadAll(resp.Body)
+	if err != nil {
+		return err
+	}
+	var env envelope
+	if err := json.Unmarshal(raw, &env); err != nil {
+		return fmt.Errorf("vearch-tpu: bad response (%d): %w",
+			resp.StatusCode, err)
+	}
+	if env.Code != 0 {
+		return &APIError{Code: env.Code, Msg: env.Msg}
+	}
+	if out != nil && len(env.Data) > 0 {
+		return json.Unmarshal(env.Data, out)
+	}
+	return nil
+}
+
+// -- entities ---------------------------------------------------------------
+
+// Field describes one schema field at space-create time.
+type Field struct {
+	Name      string         `json:"name"`
+	DataType  string         `json:"data_type"` // string/integer/float/date/vector/...
+	Dimension int            `json:"dimension,omitempty"`
+	Index     map[string]any `json:"index,omitempty"` // {index_type, metric_type, params}
+}
+
+// RulePartition is one range of a RANGE partition rule.
+type RulePartition struct {
+	Name  string `json:"name"`
+	Value any    `json:"value"`
+}
+
+// PartitionRule routes writes by a scalar field.
+type PartitionRule struct {
+	Type   string          `json:"type"` // "RANGE"
+	Field  string          `json:"field"`
+	Ranges []RulePartition `json:"ranges"`
+}
+
+// SpaceConfig is the body of a create-space request.
+type SpaceConfig struct {
+	Name          string         `json:"name"`
+	PartitionNum  int            `json:"partition_num,omitempty"`
+	ReplicaNum    int            `json:"replica_num,omitempty"`
+	Fields        []Field        `json:"fields"`
+	PartitionRule *PartitionRule `json:"partition_rule,omitempty"`
+}
+
+// Document is an upsert payload: field name -> value; "_id" optional.
+type Document map[string]any
+
+// SearchVector names one query vector batch for a field; Feature is a
+// flattened [b*d] batch.
+type SearchVector struct {
+	Field   string    `json:"field"`
+	Feature []float32 `json:"feature"`
+}
+
+// SearchRequest mirrors POST /document/search.
+type SearchRequest struct {
+	DBName      string         `json:"db_name"`
+	SpaceName   string         `json:"space_name"`
+	Vectors     []SearchVector `json:"vectors"`
+	Limit       int            `json:"limit,omitempty"`
+	Filters     map[string]any `json:"filters,omitempty"`
+	Fields      []string       `json:"fields,omitempty"`
+	IndexParams map[string]any `json:"index_params,omitempty"`
+	Ranker      map[string]any `json:"ranker,omitempty"`
+	LoadBalance string         `json:"load_balance,omitempty"`
+	Trace       bool           `json:"trace,omitempty"`
+}
+
+// Hit is one search result row (dynamic fields ride alongside).
+type Hit map[string]any
+
+// ID returns the document id of a hit.
+func (h Hit) ID() string { s, _ := h["_id"].(string); return s }
+
+// Score returns the metric-oriented score of a hit.
+func (h Hit) Score() float64 { f, _ := h["_score"].(float64); return f }
+
+// -- databases --------------------------------------------------------------
+
+// CreateDatabase creates a database.
+func (c *Client) CreateDatabase(db string) error {
+	return c.do("POST", "/dbs/"+db, nil, nil)
+}
+
+// DropDatabase removes an empty database.
+func (c *Client) DropDatabase(db string) error {
+	return c.do("DELETE", "/dbs/"+db, nil, nil)
+}
+
+// ListDatabases returns all databases.
+func (c *Client) ListDatabases() ([]map[string]any, error) {
+	var out struct {
+		DBs []map[string]any `json:"dbs"`
+	}
+	err := c.do("GET", "/dbs", nil, &out)
+	return out.DBs, err
+}
+
+// -- spaces -----------------------------------------------------------------
+
+// CreateSpace creates a space in db.
+func (c *Client) CreateSpace(db string, cfg SpaceConfig) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("POST", "/dbs/"+db+"/spaces", cfg, &out)
+	return out, err
+}
+
+// DropSpace deletes a space and its partitions cluster-wide.
+func (c *Client) DropSpace(db, space string) error {
+	return c.do("DELETE", "/dbs/"+db+"/spaces/"+space, nil, nil)
+}
+
+// GetSpace fetches space metadata (partitions, rule, schema).
+func (c *Client) GetSpace(db, space string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/dbs/"+db+"/spaces/"+space, nil, &out)
+	return out, err
+}
+
+// UpdatePartitionRule adds or drops rule partitions online.
+// op is "ADD" (with rule.Ranges) or "DROP" (with partitionName).
+func (c *Client) UpdatePartitionRule(db, space, op, partitionName string,
+	rule *PartitionRule) (map[string]any, error) {
+	body := map[string]any{
+		"db_name": db, "space_name": space, "operator_type": op,
+	}
+	if partitionName != "" {
+		body["partition_name"] = partitionName
+	}
+	if rule != nil {
+		body["partition_rule"] = rule
+	}
+	var out map[string]any
+	err := c.do("POST", "/partitions/rule", body, &out)
+	return out, err
+}
+
+// -- documents --------------------------------------------------------------
+
+// Upsert inserts or updates documents; returns assigned ids.
+func (c *Client) Upsert(db, space string, docs []Document) ([]string, error) {
+	var out struct {
+		Total int      `json:"total"`
+		IDs   []string `json:"document_ids"`
+	}
+	err := c.do("POST", "/document/upsert", map[string]any{
+		"db_name": db, "space_name": space, "documents": docs,
+	}, &out)
+	return out.IDs, err
+}
+
+// Search runs a batched vector search; result is one hit list per query.
+func (c *Client) Search(req SearchRequest) ([][]Hit, error) {
+	var out struct {
+		Documents [][]Hit `json:"documents"`
+	}
+	err := c.do("POST", "/document/search", req, &out)
+	return out.Documents, err
+}
+
+// Query fetches documents by id or by scalar filter with pagination.
+func (c *Client) Query(db, space string, ids []string,
+	filters map[string]any, limit, offset int) ([]Hit, error) {
+	body := map[string]any{
+		"db_name": db, "space_name": space,
+		"limit": limit, "offset": offset,
+	}
+	if len(ids) > 0 {
+		body["document_ids"] = ids
+	}
+	if filters != nil {
+		body["filters"] = filters
+	}
+	var out struct {
+		Documents []Hit `json:"documents"`
+	}
+	err := c.do("POST", "/document/query", body, &out)
+	return out.Documents, err
+}
+
+// Delete removes documents by id or by filter; limit bounds a filtered
+// delete globally (0 keeps the explicit zero budget: nothing deleted;
+// pass a negative limit for "no limit").
+func (c *Client) Delete(db, space string, ids []string,
+	filters map[string]any, limit int) (int, error) {
+	body := map[string]any{"db_name": db, "space_name": space}
+	if len(ids) > 0 {
+		body["document_ids"] = ids
+	}
+	if filters != nil {
+		body["filters"] = filters
+	}
+	if limit >= 0 {
+		body["limit"] = limit
+	}
+	var out struct {
+		Total int `json:"total"`
+	}
+	err := c.do("POST", "/document/delete", body, &out)
+	return out.Total, err
+}
+
+// -- index ops --------------------------------------------------------------
+
+// Flush checkpoints every partition of the space.
+func (c *Client) Flush(db, space string) error {
+	return c.do("POST", "/index/flush",
+		map[string]any{"db_name": db, "space_name": space}, nil)
+}
+
+// ForceMerge triggers index training/build on every partition.
+func (c *Client) ForceMerge(db, space string) error {
+	return c.do("POST", "/index/forcemerge",
+		map[string]any{"db_name": db, "space_name": space}, nil)
+}
+
+// Rebuild retrains indexes from scratch on every partition.
+func (c *Client) Rebuild(db, space string) error {
+	return c.do("POST", "/index/rebuild",
+		map[string]any{"db_name": db, "space_name": space}, nil)
+}
+
+// -- aliases / users / health ----------------------------------------------
+
+// CreateAlias points alias at db/space.
+func (c *Client) CreateAlias(alias, db, space string) error {
+	return c.do("POST",
+		"/alias/"+alias+"/dbs/"+db+"/spaces/"+space, nil, nil)
+}
+
+// DropAlias removes an alias.
+func (c *Client) DropAlias(alias string) error {
+	return c.do("DELETE", "/alias/"+alias, nil, nil)
+}
+
+// IsLive reports whether the cluster answers health checks.
+func (c *Client) IsLive() bool {
+	return c.do("GET", "/cluster/health", nil, nil) == nil
+}

@@ -1,0 +1,3 @@
+module github.com/vearch-tpu/vearch-tpu/sdk/go
+
+go 1.21

@@ -59,3 +59,81 @@ def test_debug_stacks_endpoint():
         assert "thread" in text and "_serve" in text
     finally:
         m.stop()
+
+
+def test_request_kill_switch(tmp_path, rng):
+    """Kill switch + slow-request killer (reference: Set/DeleteKillStatus
+    c_api surface + ps/schedule_job.go:252 slow-request killer)."""
+    import numpy as np
+
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.engine.engine import (
+        Engine, RequestContext, RequestKilled, SearchRequest,
+    )
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+
+    # engine level: a pre-killed context aborts before any device work
+    schema = TableSchema("k", [
+        FieldSchema("v", DataType.VECTOR, dimension=8,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": "a", "v": [0.0] * 8}])
+    ctx = RequestContext("r1")
+    ctx.kill("operator")
+    import pytest as _pytest
+
+    with _pytest.raises(RequestKilled):
+        eng.search(SearchRequest(
+            vectors={"v": np.zeros((1, 8), np.float32)}, k=1, ctx=ctx))
+
+    # PS level: the slow killer aborts a first-compile search
+    master = MasterServer()
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=master.addr)
+    ps.start()
+    try:
+        rpc.call(ps.addr, "POST", "/ps/partition/create", {
+            "partition": {"id": 1, "space_id": 1, "db_name": "d",
+                          "space_name": "s", "slot": 0, "replicas": [],
+                          "leader": -1},
+            "schema": {"name": "s", "fields": [
+                {"name": "v", "data_type": "vector", "dimension": 24,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}}]},
+        })
+        vecs = rng.standard_normal((50, 24)).astype(np.float32)
+        rpc.call(ps.addr, "POST", "/ps/doc/upsert", {
+            "partition_id": 1,
+            "documents": [{"_id": f"d{i}", "v": vecs[i].tolist()}
+                          for i in range(50)]})
+        rpc.call(ps.addr, "POST", "/ps/engine/config",
+                 {"partition_id": 1, "config": {"slow_request_ms": 1}})
+        import time as _time
+
+        _time.sleep(0.7)  # let the killer re-arm at the fast tick
+        # first search compiles (>> 1ms): the killer flips the ctx and
+        # the engine aborts at its next phase boundary -> 408
+        with _pytest.raises(rpc.RpcError, match="killed") as ei:
+            rpc.call(ps.addr, "POST", "/ps/doc/search",
+                     {"partition_id": 1, "vectors": {"v": vecs[:3]},
+                      "k": 5, "request_id": "victim"})
+        assert ei.value.code == 408
+        assert rpc.call(ps.addr, "GET", "/ps/stats")["killed_requests"] >= 1
+        # disable the killer: the same search now completes
+        rpc.call(ps.addr, "POST", "/ps/engine/config",
+                 {"partition_id": 1, "config": {"slow_request_ms": 0}})
+        out = rpc.call(ps.addr, "POST", "/ps/doc/search",
+                       {"partition_id": 1, "vectors": {"v": vecs[:3]},
+                        "k": 5})
+        assert out["results"][0][0]["_id"] == "d0"
+        # killing an unknown request is a loud 404
+        with _pytest.raises(rpc.RpcError, match="not in flight"):
+            rpc.call(ps.addr, "POST", "/ps/kill", {"request_id": "nope"})
+    finally:
+        ps.stop()
+        master.stop()

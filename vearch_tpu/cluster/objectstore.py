@@ -26,6 +26,12 @@ import zlib
 MANIFEST = "MANIFEST.json"
 
 
+def s3_endpoint_host(endpoint: str) -> str:
+    """Normalize an endpoint to its host:port — shared by the client and
+    the PS allowlist check so both accept/deny identically."""
+    return endpoint.split("://", 1)[-1].rstrip("/")
+
+
 def is_within(root: str, path: str) -> bool:
     """True when `path` resolves inside `root` (commonpath, not string
     prefix: '<root>-evil/x' shares the prefix but not the directory)."""
@@ -178,6 +184,11 @@ class LocalObjectStore(ObjectStore):
         os.makedirs(os.path.dirname(dst), exist_ok=True)
         shutil.copyfile(local_path, dst)
 
+    def get_file(self, key: str, local_path: str) -> None:
+        # streamed copy: multi-GB shard files never sit in memory
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        shutil.copyfile(self._path(key), local_path)
+
     def list(self, prefix: str) -> list[str]:
         base = self._path(prefix)
         out = []
@@ -199,14 +210,20 @@ class S3ObjectStore(ObjectStore):
     def __init__(self, endpoint: str, bucket: str, access_key: str = "",
                  secret_key: str = "", region: str = "us-east-1",
                  prefix: str = ""):
+        import threading
+
         # endpoint: "host:port" or "http(s)://host:port"
         self.secure = endpoint.startswith("https://")
-        self.host = endpoint.split("://", 1)[-1].rstrip("/")
+        self.host = s3_endpoint_host(endpoint)
         self.bucket = bucket
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
         self.prefix = prefix.strip("/")
+        # one kept-alive connection per store (a tree transfer would
+        # otherwise pay a TCP/TLS handshake per file)
+        self._conn = None
+        self._conn_lock = threading.Lock()
 
     def _key(self, key: str) -> str:
         key = key.lstrip("/")
@@ -283,11 +300,9 @@ class S3ObjectStore(ObjectStore):
         else:
             payload_hash = hashlib.sha256(payload).hexdigest()
         headers = self._sign(method, path, query, payload_hash)
-        cls = http.client.HTTPSConnection if self.secure \
-            else http.client.HTTPConnection
-        conn = cls(self.host, timeout=60)
-        try:
-            url = quote(path) + (f"?{query}" if query else "")
+        url = quote(path) + (f"?{query}" if query else "")
+
+        def send(conn):
             if body_path is not None:
                 headers["Content-Length"] = str(size)
                 with open(body_path, "rb") as f:
@@ -295,28 +310,48 @@ class S3ObjectStore(ObjectStore):
             else:
                 conn.request(method, url, body=payload or None,
                              headers=headers)
-            resp = conn.getresponse()
-            if resp.status == 404:
-                resp.read()
-                raise FileNotFoundError(f"s3://{self.bucket}/{key}")
-            if resp.status >= 300:
-                body = resp.read()
-                raise IOError(
-                    f"S3 {method} {path}: {resp.status} {body[:200]!r}"
-                )
-            if stream_to is not None:
-                os.makedirs(os.path.dirname(stream_to) or ".",
-                            exist_ok=True)
-                with open(stream_to, "wb") as out:
-                    while True:
-                        buf = resp.read(1 << 20)
-                        if not buf:
-                            break
-                        out.write(buf)
-                return b""
-            return resp.read()
-        finally:
-            conn.close()
+            return conn.getresponse()
+
+        with self._conn_lock:
+            cls = http.client.HTTPSConnection if self.secure \
+                else http.client.HTTPConnection
+            try:
+                if self._conn is None:
+                    self._conn = cls(self.host, timeout=60)
+                resp = send(self._conn)
+            except (http.client.HTTPException, OSError):
+                # stale keep-alive connection: one fresh retry
+                if self._conn is not None:
+                    self._conn.close()
+                self._conn = cls(self.host, timeout=60)
+                resp = send(self._conn)
+            try:
+                if resp.status == 404:
+                    resp.read()
+                    raise FileNotFoundError(f"s3://{self.bucket}/{key}")
+                if resp.status >= 300:
+                    body = resp.read()
+                    raise IOError(
+                        f"S3 {method} {path}: {resp.status} {body[:200]!r}"
+                    )
+                if stream_to is not None:
+                    os.makedirs(os.path.dirname(stream_to) or ".",
+                                exist_ok=True)
+                    with open(stream_to, "wb") as out:
+                        while True:
+                            buf = resp.read(1 << 20)
+                            if not buf:
+                                break
+                            out.write(buf)
+                    return b""
+                return resp.read()
+            except (FileNotFoundError, IOError):
+                raise
+            except Exception:
+                # undrained response poisons keep-alive: drop the conn
+                self._conn.close()
+                self._conn = None
+                raise
 
     # -- ObjectStore interface -------------------------------------------
 
@@ -333,6 +368,7 @@ class S3ObjectStore(ObjectStore):
         self._request("GET", self._key(key), stream_to=local_path)
 
     def list(self, prefix: str) -> list[str]:
+        import html
         import re
         from urllib.parse import quote
 
@@ -344,14 +380,19 @@ class S3ObjectStore(ObjectStore):
             if token:
                 query += f"&continuation-token={quote(token, safe='')}"
             body = self._request("GET", "", query=query).decode()
-            out.extend(re.findall(r"<Key>([^<]+)</Key>", body))
+            # keys ride XML-escaped (&amp; etc.); unescape or keys with
+            # '&'/'<' silently mismatch the manifest on restore
+            out.extend(
+                html.unescape(k)
+                for k in re.findall(r"<Key>([^<]+)</Key>", body)
+            )
             m = re.search(
                 r"<NextContinuationToken>([^<]+)</NextContinuationToken>",
                 body,
             )
             if not m:
                 break
-            token = m.group(1)
+            token = html.unescape(m.group(1))
         strip = (self.prefix + "/") if self.prefix else ""
         return sorted(
             k[len(strip):] if strip and k.startswith(strip) else k

@@ -95,6 +95,13 @@ class PSServer:
         self.backup_endpoints = backup_endpoints
         self.replication_errors = 0  # surfaced in /ps/stats
         self._peer_cache: tuple[float, dict[int, str]] = (0.0, {})
+        # in-flight request registry (reference: handler_document.go:96
+        # Rqueue registration for kill + ps/schedule_job.go:252 slow-
+        # request killer). 0 disables the automatic killer.
+        self._inflight: dict[str, dict] = {}
+        self._inflight_lock = threading.Lock()
+        self.slow_request_ms = 0
+        self.killed_requests = 0
 
         self.server = JsonRpcServer(host, port)
         s = self.server
@@ -112,6 +119,8 @@ class PSServer:
         s.route("POST", "/ps/backup", self._h_backup)
         s.route("POST", "/ps/restore", self._h_restore)
         s.route("GET", "/ps/stats", self._h_stats)
+        s.route("POST", "/ps/kill", self._h_kill)
+        s.route("GET", "/ps/requests", self._h_requests)
         # raft transport (reference: raftstore/server.go heartbeat +
         # replicate ports; here routes on the one RPC server)
         s.route("POST", "/ps/raft/append", self._h_raft_append)
@@ -132,6 +141,7 @@ class PSServer:
             threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._flush_loop, daemon=True).start()
         threading.Thread(target=self._raft_tick_loop, daemon=True).start()
+        threading.Thread(target=self._slow_killer_loop, daemon=True).start()
 
     def stop(self, flush: bool = True) -> None:
         self._stop.set()
@@ -202,9 +212,14 @@ class PSServer:
                     current[int(p["id"])] = p
             except RpcError:
                 pass
+        import re as _re
+
         for name in sorted(os.listdir(self.data_dir)):
             pdir = os.path.join(self.data_dir, name)
-            if not (name.startswith("partition_") and os.path.isdir(pdir)):
+            # exact partition dirs only (a crashed restore may leave
+            # partition_<pid>.restore.* staging dirs behind)
+            if not (_re.fullmatch(r"partition_\d+", name)
+                    and os.path.isdir(pdir)):
                 continue
             pid = int(name.split("_")[1])
             try:
@@ -555,8 +570,63 @@ class PSServer:
         return {"documents": eng.get(body["keys"], body.get("fields"),
                                       bool(body.get("vector_value", False)))}
 
+    # -- kill switch / slow-request isolation (reference: Set/Delete
+    #    KillStatus c_api + Rqueue, handler_document.go:96; slow-request
+    #    killer, ps/schedule_job.go:252) ------------------------------------
+
+    def _slow_killer_loop(self) -> None:
+        while not self._stop.is_set():
+            # tick fast enough to catch requests near the limit, but
+            # never busier than 20Hz; re-read the limit AFTER sleeping
+            # so a runtime config change takes effect within one tick
+            time.sleep(max(0.05, min(0.5,
+                                     (self.slow_request_ms or 2000) / 4000.0)))
+            limit = self.slow_request_ms
+            if not limit:
+                continue
+            now = time.time()
+            with self._inflight_lock:
+                for rid, info in self._inflight.items():
+                    if (now - info["start"]) * 1e3 > limit and \
+                            not info["ctx"].killed:
+                        info["ctx"].kill(
+                            f"slow request killed after {limit}ms"
+                        )
+                        self.killed_requests += 1
+
+    def _h_kill(self, body: dict, _parts) -> dict:
+        """Kill in-flight request(s) by id (reference: SetKillStatus).
+        A retried request may share its id with the original — kill
+        every matching entry (the registry is keyed by a unique token
+        so duplicates never shadow each other)."""
+        rid = str(body["request_id"])
+        killed = 0
+        with self._inflight_lock:
+            for info in self._inflight.values():
+                if info["rid"] == rid and not info["ctx"].killed:
+                    info["ctx"].kill("killed by operator")
+                    killed += 1
+        if not killed:
+            raise RpcError(404, f"request {rid!r} not in flight")
+        self.killed_requests += killed
+        return {"request_id": rid, "killed": killed}
+
+    def _h_requests(self, _body, _parts) -> dict:
+        now = time.time()
+        with self._inflight_lock:
+            return {"requests": [
+                {"request_id": i["rid"],
+                 "elapsed_ms": round((now - i["start"]) * 1e3, 1),
+                 "killed": i["ctx"].killed}
+                for i in self._inflight.values()
+            ]}
+
     def _h_search(self, body: dict, _parts) -> dict:
+        import uuid
+
         import numpy as np
+
+        from vearch_tpu.engine.engine import RequestContext, RequestKilled
 
         eng = self._engine(body["partition_id"])
         vectors = {
@@ -565,12 +635,22 @@ class PSServer:
         }
         if not self._search_gate.acquire(timeout=30.0):
             raise RpcError(429, "partition server search queue full")
+        rid = str(body.get("request_id") or uuid.uuid4().hex)
+        token = uuid.uuid4().hex  # unique even when clients reuse rids
+        ctx = RequestContext(rid)
+        with self._inflight_lock:
+            self._inflight[token] = {"rid": rid, "start": time.time(),
+                                     "ctx": ctx}
         try:
-            return self._do_search(eng, body, vectors)
+            return self._do_search(eng, body, vectors, ctx)
+        except RequestKilled as e:
+            raise RpcError(408, f"request {rid}: {e}") from e
         finally:
+            with self._inflight_lock:
+                self._inflight.pop(token, None)
             self._search_gate.release()
 
-    def _do_search(self, eng, body, vectors) -> dict:
+    def _do_search(self, eng, body, vectors, ctx=None) -> dict:
         trace = {} if body.get("trace") else None
         req = SearchRequest(
             vectors=vectors,
@@ -581,6 +661,7 @@ class PSServer:
             field_weights=body.get("field_weights") or {},
             index_params=body.get("index_params") or {},
             trace=trace,
+            ctx=ctx,
         )
         results = eng.search(req)
         metric = eng.indexes[next(iter(vectors))].metric.value
@@ -633,6 +714,9 @@ class PSServer:
         cfg = body.get("config") or {}
         if "memory_limit_mb" in cfg:
             self.memory_limit_mb = int(cfg["memory_limit_mb"])
+        if "slow_request_ms" in cfg:
+            # reference: slow_search_time runtime config -> slow killer
+            self.slow_request_ms = int(cfg["slow_request_ms"])
         eng = self._engine(body["partition_id"])
         return eng.apply_config(cfg)
 
@@ -658,8 +742,12 @@ class PSServer:
                 raise RpcError(403, f"store_root {root!r} not in the "
                                     f"operator backup_roots allowlist")
         else:
-            host = str(spec.get("endpoint", "")).split("://", 1)[-1]
-            if confined and host not in (self.backup_endpoints or []):
+            from vearch_tpu.cluster.objectstore import s3_endpoint_host
+
+            host = s3_endpoint_host(str(spec.get("endpoint", "")))
+            allowed = {s3_endpoint_host(e)
+                       for e in (self.backup_endpoints or [])}
+            if confined and host not in allowed:
                 raise RpcError(
                     403, f"s3 endpoint {host!r} not in the operator "
                          f"backup_endpoints allowlist"
@@ -682,15 +770,20 @@ class PSServer:
         eng = self._engine(pid)  # partition must exist (space created first)
         node = self._node(pid)
         store = self._backup_store(body)
+        import tempfile
+
         data_dir = os.path.join(self.data_dir, f"partition_{pid}")
         # download + CRC-verify into a staging dir FIRST: a network
         # failure or integrity error must leave the live partition
-        # untouched, not bricked with a wiped directory
-        stage = data_dir + ".restore"
-        shutil.rmtree(stage, ignore_errors=True)
+        # untouched, not bricked with a wiped directory. Unique staging
+        # per call + the flush lock serialise concurrent restores (and
+        # keep the flush job from interleaving writes during the swap).
+        stage = tempfile.mkdtemp(prefix=f"partition_{pid}.restore.",
+                                 dir=self.data_dir)
         try:
             n = store.get_tree(body["key_prefix"], stage)
-            with node._apply_lock:
+            with self._flush_locks.setdefault(pid, threading.Lock()), \
+                    node._apply_lock:
                 eng.close()
                 for name in list(os.listdir(data_dir)):
                     if name in ("raft", "partition.json"):
@@ -719,6 +812,7 @@ class PSServer:
         return {
             "node_id": self.node_id,
             "replication_errors": self.replication_errors,
+            "killed_requests": self.killed_requests,
             "partitions": {
                 str(pid): {
                     "doc_count": eng.doc_count,

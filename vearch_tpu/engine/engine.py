@@ -58,6 +58,34 @@ class SearchRequest:
     # (reference: per-request trace:true timing breakdown,
     # client/client.go:521-565 + PerfTool, index_model.h:24)
     trace: dict[str, float] | None = None
+    # cooperative cancellation (reference: RequestContext kill status,
+    # api_data/request_context.h + Set/DeleteKillStatus c_api): checked
+    # at phase boundaries — a killed request aborts before its next
+    # device dispatch rather than mid-kernel
+    ctx: "RequestContext | None" = None
+
+
+class RequestKilled(Exception):
+    pass
+
+
+class RequestContext:
+    """Kill flag for one in-flight request (reference:
+    api_data/request_context.h; the PS slow-request killer and the
+    /ps/kill admin both flip it)."""
+
+    def __init__(self, request_id: str = ""):
+        self.request_id = request_id
+        self.killed = False
+        self.reason = ""
+
+    def kill(self, reason: str = "killed") -> None:
+        self.killed = True
+        self.reason = reason
+
+    def check(self) -> None:
+        if self.killed:
+            raise RequestKilled(self.reason or "request killed")
 
 
 class Engine:
@@ -367,6 +395,8 @@ class Engine:
         queries_by_field: dict[str, np.ndarray] = {}
         fetch_k = req.k if len(req.vectors) == 1 else max(req.k * 4, 50)
         for name, queries in req.vectors.items():
+            if req.ctx is not None:
+                req.ctx.check()
             index = self.indexes[name]
             queries = np.asarray(queries)
             if queries.ndim == 1:
@@ -400,6 +430,8 @@ class Engine:
                     (_time.time() - t_start) * 1e3, 3
                 )
 
+        if req.ctx is not None:
+            req.ctx.check()
         merged = self._merge_fields(per_field, queries_by_field, req)
         results = self._shape_results(merged, req)
         if req.trace is not None:
