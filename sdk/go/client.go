@@ -149,6 +149,8 @@ type SearchRequest struct {
 	SpaceName   string         `json:"space_name"`
 	Vectors     []SearchVector `json:"vectors"`
 	Limit       int            `json:"limit,omitempty"`
+	RequestID   string         `json:"request_id,omitempty"` // for /ps/kill
+
 	Filters     map[string]any `json:"filters,omitempty"`
 	Fields      []string       `json:"fields,omitempty"`
 	IndexParams map[string]any `json:"index_params,omitempty"`

@@ -1,0 +1,182 @@
+"""Per-index recall gates against exact ground truth (reference:
+test/test_recall_baseline.py:301-303 — recall@100 >= 0.9, @10 >= 0.8,
+@1 >= 0.5, gated per index type on real datasets vs an in-process faiss
+oracle; this image has zero egress, so the dataset is the same
+clustered-Gaussian SIFT-like generator bench.py uses and the oracle is
+an exact numpy scan — the gate thresholds are the reference's own)."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+
+N, D, NQ = 30_000, 64, 64
+
+R_AT_100 = 0.9
+R_AT_10 = 0.8
+R_AT_1 = 0.5
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    nc = 300
+    centers = (rng.standard_normal((nc, D)) * 3).astype(np.float32)
+    which = rng.integers(0, nc, N)
+    base = centers[which] + 0.7 * rng.standard_normal((N, D)).astype(
+        np.float32
+    )
+    q_idx = rng.choice(N, NQ, replace=False)
+    queries = base[q_idx] + 0.1 * rng.standard_normal((NQ, D)).astype(
+        np.float32
+    )
+    # exact L2 ground truth (the oracle): full f64 scan
+    d2 = (
+        np.sum(queries.astype(np.float64) ** 2, axis=1)[:, None]
+        - 2.0 * queries.astype(np.float64) @ base.astype(np.float64).T
+        + np.sum(base.astype(np.float64) ** 2, axis=1)[None, :]
+    )
+    gt = np.argsort(d2, axis=1)[:, :100]
+    return base, queries, gt
+
+
+def build_engine(index_params: IndexParams, base: np.ndarray) -> Engine:
+    schema = TableSchema("r", [
+        FieldSchema("v", DataType.VECTOR, dimension=D, index=index_params),
+    ])
+    eng = Engine(schema)
+    step = 10_000
+    for i in range(0, N, step):
+        eng.upsert([{"_id": str(j), "v": base[j]}
+                    for j in range(i, i + step)])
+    eng.build_index()
+    return eng
+
+
+def recalls(eng: Engine, queries, gt, index_params=None):
+    req = SearchRequest(vectors={"v": queries}, k=100, include_fields=[],
+                        index_params=index_params or {})
+    res = eng.search(req)
+    got = [[int(it.key) for it in r.items] for r in res]
+    out = {}
+    for k in (1, 10, 100):
+        out[k] = float(np.mean([
+            len(set(got[q][:k]) & set(gt[q][:k].tolist())) / k
+            for q in range(len(got))
+        ]))
+    return out
+
+
+def assert_gates(r, name):
+    assert r[100] >= R_AT_100, f"{name} recall@100 {r[100]:.3f} < {R_AT_100}"
+    assert r[10] >= R_AT_10, f"{name} recall@10 {r[10]:.3f} < {R_AT_10}"
+    assert r[1] >= R_AT_1, f"{name} recall@1 {r[1]:.3f} < {R_AT_1}"
+
+
+def test_recall_flat(dataset):
+    base, queries, gt = dataset
+    eng = build_engine(IndexParams("FLAT", MetricType.L2, {}), base)
+    r = recalls(eng, queries, gt)
+    # exact index: hold it to far above the generic gates
+    assert r[1] >= 0.99 and r[10] >= 0.99, r
+
+
+def test_recall_ivfflat(dataset):
+    base, queries, gt = dataset
+    eng = build_engine(IndexParams("IVFFLAT", MetricType.L2, {
+        "ncentroids": 128, "nprobe": 24, "train_iters": 6,
+        "training_threshold": N,
+    }), base)
+    assert_gates(recalls(eng, queries, gt), "IVFFLAT")
+
+
+def test_recall_ivfpq_full_scan(dataset):
+    base, queries, gt = dataset
+    eng = build_engine(IndexParams("IVFPQ", MetricType.L2, {
+        "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
+        "training_threshold": N,
+    }), base)
+    assert_gates(
+        recalls(eng, queries, gt, {"rerank": 256}), "IVFPQ/full"
+    )
+
+
+def test_recall_ivfpq_probe_mode(dataset):
+    base, queries, gt = dataset
+    eng = build_engine(IndexParams("IVFPQ", MetricType.L2, {
+        "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
+        "training_threshold": N, "scan_mode": "probe", "nprobe": 24,
+    }), base)
+    assert_gates(
+        recalls(eng, queries, gt, {"rerank": 256}), "IVFPQ/probe"
+    )
+
+
+def test_recall_hnsw_surface(dataset):
+    base, queries, gt = dataset
+    eng = build_engine(IndexParams("HNSW", MetricType.L2, {
+        "nlinks": 32, "efSearch": 64, "training_threshold": N,
+    }), base)
+    assert_gates(recalls(eng, queries, gt), "HNSW")
+
+
+def test_recall_ivfrabitq(dataset):
+    base, queries, gt = dataset
+    eng = build_engine(IndexParams("IVFRABITQ", MetricType.L2, {
+        "ncentroids": 128, "train_iters": 6, "training_threshold": N,
+    }), base)
+    assert_gates(
+        recalls(eng, queries, gt, {"rerank": 512}), "IVFRABITQ"
+    )
+
+
+def test_recall_binaryivf():
+    """Hamming ground truth on packed binary vectors (reference:
+    test_vector_index_binary_ivf parity)."""
+    rng = np.random.default_rng(11)
+    n, dbits, nq = 20_000, 256, 32
+    # clustered bits (uniform random bits have no coarse-cluster
+    # structure at all — IVF on them degenerates to random bucketing;
+    # real binary descriptors cluster, like the reference's datasets)
+    nc = 64
+    centers = rng.integers(0, 2, (nc, dbits), dtype=np.uint8)
+    which = rng.integers(0, nc, n)
+    noise = (rng.random((n, dbits)) < 0.10).astype(np.uint8)
+    bits = centers[which] ^ noise
+    packed = np.packbits(bits, axis=1)
+    q_idx = rng.choice(n, nq, replace=False)
+    # queries: ground-truth rows with ~8% bit noise
+    qbits = bits[q_idx].copy()
+    flip = rng.random((nq, dbits)) < 0.08
+    qbits ^= flip.astype(np.uint8)
+    qpacked = np.packbits(qbits, axis=1)
+
+    # hamming ground truth via xor on unpacked bits
+    ham = (qbits[:, None, :] ^ bits[None, :, :]).sum(axis=2)
+    gt = np.argsort(ham, axis=1, kind="stable")[:, :100]
+
+    schema = TableSchema("b", [
+        FieldSchema("v", DataType.VECTOR, dimension=dbits,
+                    index=IndexParams("BINARYIVF", MetricType.L2, {
+                        "ncentroids": 64, "nprobe": 16,
+                        "training_threshold": n,
+                    })),
+    ])
+    eng = Engine(schema)
+    for i in range(0, n, 5000):
+        eng.upsert([{"_id": str(j), "v": packed[j]}
+                    for j in range(i, i + 5000)])
+    eng.build_index()
+    req = SearchRequest(vectors={"v": qpacked}, k=100, include_fields=[])
+    res = eng.search(req)
+    got = [[int(it.key) for it in r.items] for r in res]
+    r10 = float(np.mean([
+        len(set(got[q][:10]) & set(gt[q][:10].tolist())) / 10
+        for q in range(nq)
+    ]))
+    r1 = float(np.mean([got[q][0] == gt[q][0] for q in range(nq)]))
+    assert r10 >= R_AT_10, f"BINARYIVF recall@10 {r10:.3f}"
+    assert r1 >= R_AT_1, f"BINARYIVF recall@1 {r1:.3f}"

@@ -26,6 +26,12 @@ import zlib
 MANIFEST = "MANIFEST.json"
 
 
+class S3HttpError(IOError):
+    """Deliberate S3 error raised AFTER the response body was drained —
+    the keep-alive connection is still reusable (unlike transport-level
+    OSErrors mid-body, which must drop the connection)."""
+
+
 def s3_endpoint_host(endpoint: str) -> str:
     """Normalize an endpoint to its host:port — shared by the client and
     the PS allowlist check so both accept/deny identically."""
@@ -327,11 +333,11 @@ class S3ObjectStore(ObjectStore):
                 resp = send(self._conn)
             try:
                 if resp.status == 404:
-                    resp.read()
+                    resp.read()  # drained: connection stays reusable
                     raise FileNotFoundError(f"s3://{self.bucket}/{key}")
                 if resp.status >= 300:
                     body = resp.read()
-                    raise IOError(
+                    raise S3HttpError(
                         f"S3 {method} {path}: {resp.status} {body[:200]!r}"
                     )
                 if stream_to is not None:
@@ -345,10 +351,12 @@ class S3ObjectStore(ObjectStore):
                             out.write(buf)
                     return b""
                 return resp.read()
-            except (FileNotFoundError, IOError):
-                raise
+            except (FileNotFoundError, S3HttpError):
+                raise  # drained above: keep-alive intact
             except Exception:
-                # undrained response poisons keep-alive: drop the conn
+                # anything else (reset mid-body, disk full during the
+                # streamed write, ...) leaves an undrained response
+                # that would poison keep-alive: drop the connection
                 self._conn.close()
                 self._conn = None
                 raise

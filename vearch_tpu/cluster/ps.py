@@ -216,8 +216,12 @@ class PSServer:
 
         for name in sorted(os.listdir(self.data_dir)):
             pdir = os.path.join(self.data_dir, name)
-            # exact partition dirs only (a crashed restore may leave
-            # partition_<pid>.restore.* staging dirs behind)
+            # a crashed restore leaves partition_<pid>.restore.* staging
+            # dirs: reclaim them at startup or they accumulate shard-
+            # sized garbage across crash/restore cycles
+            if _re.fullmatch(r"partition_\d+\.restore\..*", name):
+                shutil.rmtree(pdir, ignore_errors=True)
+                continue
             if not (_re.fullmatch(r"partition_\d+", name)
                     and os.path.isdir(pdir)):
                 continue
@@ -606,9 +610,9 @@ class PSServer:
                 if info["rid"] == rid and not info["ctx"].killed:
                     info["ctx"].kill("killed by operator")
                     killed += 1
+            self.killed_requests += killed
         if not killed:
             raise RpcError(404, f"request {rid!r} not in flight")
-        self.killed_requests += killed
         return {"request_id": rid, "killed": killed}
 
     def _h_requests(self, _body, _parts) -> dict:

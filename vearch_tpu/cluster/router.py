@@ -357,6 +357,9 @@ class RouterServer:
         sub = {
             "vectors": vectors,
             "k": k,
+            # forwarded so /ps/kill can target queries by the id the
+            # client supplied (reference: Rqueue kill by request id)
+            "request_id": body.get("request_id"),
             "filters": body.get("filters"),
             "include_fields": body.get("fields"),
             "index_params": body.get("index_params") or {},
