@@ -1,0 +1,229 @@
+"""Multi-master replicated metadata plane (reference: embedded etcd
+raft, master/server.go:89 — three masters, any of which serves the API;
+metadata survives leader loss and a restarted master catches up)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.master import MasterServer
+from vearch_tpu.cluster.ps import PSServer
+from vearch_tpu.cluster.router import RouterServer
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def make_masters(tmp_path, n=3, timeout=1.0):
+    ids = list(range(1, n + 1))
+    masters = []
+    for i in ids:
+        m = MasterServer(
+            persist_path=str(tmp_path / f"m{i}" / "meta.json"),
+            meta_dir=str(tmp_path / f"m{i}"),
+            node_id=i, peers={j: "" for j in ids},
+            election_timeout=timeout, heartbeat_ttl=2.0,
+        )
+        masters.append(m)
+    addrs = {m.node_id: m.addr for m in masters}
+    for m in masters:
+        m.peers = dict(addrs)
+    for m in masters:
+        m.start()
+    return masters
+
+
+def wait_leader(masters, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.1)
+    raise AssertionError(
+        f"no single leader: {[(m.node_id, m.is_leader) for m in masters]}"
+    )
+
+
+def multi_addr(masters):
+    return ",".join(m.addr for m in masters)
+
+
+def test_election_and_replicated_writes(tmp_path):
+    masters = make_masters(tmp_path)
+    try:
+        leader = wait_leader(masters)
+        followers = [m for m in masters if m is not leader]
+        # write through a FOLLOWER: it must proxy to the leader
+        rpc.call(followers[0].addr, "POST", "/dbs/repl")
+        # the write is visible on every master's local store
+        for m in masters:
+            out = rpc.call(m.addr, "GET", "/dbs")
+            assert [d["name"] for d in out["dbs"]] == ["repl"], m.node_id
+        # sequences replicate: ids stay unique across the group
+        a = leader.store.next_id("/seq/test")
+        b = leader.store.next_id("/seq/test")
+        assert (a, b) == (1, 2)
+        for m in followers:
+            assert m.store.get("/seq/test") == 2
+    finally:
+        for m in masters:
+            m.stop()
+
+
+def test_leader_death_metadata_survives(tmp_path):
+    masters = make_masters(tmp_path)
+    try:
+        leader = wait_leader(masters)
+        rpc.call(multi_addr(masters), "POST", "/dbs/durable")
+        leader.stop()
+        alive = [m for m in masters if m is not leader]
+        new_leader = wait_leader(alive)
+        assert new_leader is not leader
+        # metadata survives and writes keep working through any address
+        out = rpc.call(multi_addr(alive), "GET", "/dbs")
+        assert [d["name"] for d in out["dbs"]] == ["durable"]
+        rpc.call(multi_addr(alive), "POST", "/dbs/after")
+        out = rpc.call(multi_addr(alive), "GET", "/dbs")
+        assert {d["name"] for d in out["dbs"]} == {"durable", "after"}
+    finally:
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+
+def test_full_cluster_on_multimaster(tmp_path, rng):
+    """PS + router against the 3-master group: space create, writes,
+    search, and PS registration keep working after the leader dies."""
+    masters = make_masters(tmp_path)
+    ps = router = None
+    try:
+        leader = wait_leader(masters)
+        maddr = multi_addr(masters)
+        ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=maddr,
+                      heartbeat_interval=0.3)
+        ps.start()
+        router = RouterServer(master_addr=maddr)
+        router.start()
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((40, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(40)])
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d3"
+
+        # kill the metadata leader: the cluster keeps serving
+        leader.stop()
+        alive = [m for m in masters if m is not leader]
+        wait_leader(alive)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                cl.upsert("db", "s", [{"_id": "post", "v": vecs[0]}])
+                break
+            except rpc.RpcError:
+                time.sleep(0.3)
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[17]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d17"
+        docs = cl.query("db", "s", document_ids=["post"])
+        assert docs and docs[0]["_id"] == "post"
+    finally:
+        if router:
+            router.stop()
+        if ps:
+            ps.stop(flush=False)
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+
+def test_restarted_master_catches_up(tmp_path):
+    masters = make_masters(tmp_path)
+    try:
+        wait_leader(masters)
+        rpc.call(multi_addr(masters), "POST", "/dbs/before")
+        # stop a follower, write more, restart it on the same dirs
+        leader = next(m for m in masters if m.is_leader)
+        victim = next(m for m in masters if not m.is_leader)
+        vid = victim.node_id
+        victim.stop()
+        rpc.call(multi_addr([m for m in masters if m is not victim]),
+                 "POST", "/dbs/while_down")
+        m2 = MasterServer(
+            persist_path=str(tmp_path / f"m{vid}" / "meta.json"),
+            meta_dir=str(tmp_path / f"m{vid}"),
+            node_id=vid, peers=dict(victim.peers),
+            election_timeout=0.6, heartbeat_ttl=2.0,
+        )
+        m2.peers[vid] = m2.addr
+        # tell the others its new address
+        for m in masters:
+            if m is not victim:
+                m.peers[vid] = m2.addr
+        m2.start()
+        masters.append(m2)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if {d for d in m2.store.prefix("/db/")} == \
+                    {"/db/before", "/db/while_down"}:
+                break
+            time.sleep(0.2)
+        assert set(m2.store.prefix("/db/")) == \
+            {"/db/before", "/db/while_down"}
+    finally:
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+
+def test_multimaster_with_auth(tmp_path):
+    """Auth-enabled 3-master group: peer raft RPCs are exempt, client
+    credentials travel with follower->leader proxying (review r2)."""
+    ids = [1, 2, 3]
+    masters = []
+    for i in ids:
+        m = MasterServer(
+            persist_path=str(tmp_path / f"m{i}" / "meta.json"),
+            meta_dir=str(tmp_path / f"m{i}"),
+            node_id=i, peers={j: "" for j in ids},
+            election_timeout=1.0, heartbeat_ttl=2.0,
+            auth=True, root_password="pw",
+        )
+        masters.append(m)
+    addrs = {m.node_id: m.addr for m in masters}
+    for m in masters:
+        m.peers = dict(addrs)
+    for m in masters:
+        m.start()
+    try:
+        leader = wait_leader(masters)
+        follower = next(m for m in masters if m is not leader)
+        root = ("root", "pw")
+        # unauthenticated write through a follower: rejected
+        with pytest.raises(rpc.RpcError, match="Basic auth"):
+            rpc.call(follower.addr, "POST", "/dbs/nope")
+        # authenticated write through a follower: proxied + replicated
+        rpc.call(follower.addr, "POST", "/dbs/authed", auth=root)
+        for m in masters:
+            out = rpc.call(m.addr, "GET", "/dbs", auth=root)
+            assert [d["name"] for d in out["dbs"]] == ["authed"]
+    finally:
+        for m in masters:
+            m.stop()

@@ -160,12 +160,21 @@ def parse_basic_auth(headers) -> tuple[str, str]:
 class AuthService:
     """Master-side user/role registry over the metastore."""
 
-    def __init__(self, store, root_password: str = "secret"):
+    def __init__(self, store, root_password: str = "secret",
+                 bootstrap: bool = True):
         self.store = store
+        self._root_password = root_password
+        if bootstrap:
+            self.ensure_bootstrap()
+
+    def ensure_bootstrap(self) -> None:
+        """Write root user + builtin roles if missing. In multi-master
+        mode this runs on the metadata leader only (mutations replicate
+        through the log; a follower couldn't propose them)."""
         if self.store.get(f"/user/{ROOT_NAME}") is None:
             self.store.put(f"/user/{ROOT_NAME}", {
                 "name": ROOT_NAME,
-                "password": hash_password(root_password),
+                "password": hash_password(self._root_password),
                 "role": "root",
             })
         for name, privileges in BUILTIN_ROLES.items():

@@ -44,6 +44,10 @@ class MasterServer:
         root_password: str = "secret",
         auto_recover: bool = True,
         recover_delay: float = 5.0,
+        node_id: int = 1,
+        peers: dict[int, str] | None = None,
+        meta_dir: str | None = None,
+        election_timeout: float = 1.0,
     ):
         from vearch_tpu.cluster.auth import AuthService, parse_basic_auth
 
@@ -58,7 +62,33 @@ class MasterServer:
         # two concurrent reconfigs could fence at the same term and
         # appoint two leaders, defeating the fencing safety argument
         self._reconfig_lock = threading.Lock()
-        self.auth_service = AuthService(self.store, root_password)
+
+        # -- multi-master metadata group (reference: embedded etcd raft,
+        # master/server.go:89). peers: {master_node_id: "host:port"}
+        # including self; >1 entries = replicated mode with voted
+        # elections (cluster/raft.py election mode). Followers proxy
+        # non-GET API calls to the current leader and serve reads from
+        # their replicated store.
+        self.node_id = node_id
+        self.peers = dict(peers) if peers else {node_id: ""}
+        self.replicated = len(self.peers) > 1
+        self.meta_node = None
+        self._was_leader = not self.replicated
+        self.election_timeout = election_timeout
+        if self.replicated:
+            assert meta_dir, "multi-master mode needs meta_dir for the WAL"
+            # the WAL gets truncated behind checkpoints; without a
+            # persisted store snapshot a restart would silently lose
+            # everything before the truncation horizon
+            assert persist_path, "multi-master mode needs persist_path"
+            self.auth_service = AuthService(self.store, root_password,
+                                            bootstrap=False)
+        else:
+            self.auth_service = AuthService(self.store, root_password)
+            # a restarted master has persisted /server/ records but
+            # empty in-memory leases; grant each a fresh short lease so
+            # dead nodes expire through the normal reaper
+            self._adopt_server_leases()
 
         def authenticator(headers, method, path):
             # per-endpoint privilege enforcement (reference:
@@ -67,22 +97,16 @@ class MasterServer:
             record = self.auth_service.check(user, password)
             self.auth_service.authorize(record, path, method)
 
-        # a restarted master has persisted /server/ records but empty
-        # in-memory leases; grant each a fresh short lease so dead nodes
-        # expire through the normal reaper instead of living forever
-        for key, val in self.store.prefix(PREFIX_SERVER).items():
-            node_id = int(key[len(PREFIX_SERVER):])
-            lease = self.store.grant_lease(self.heartbeat_ttl)
-            self._leases[node_id] = lease
-            self.store.put(key, val, lease=lease)
-
+        self._meta_dir = meta_dir
         self.server = JsonRpcServer(
             host,
             port,
             authenticator=authenticator if auth else None,
-            # PS registration and internal auth checks stay open
-            # (reference: /register is in the unauthenticated group)
-            auth_exempt=("/register", "/auth/check", "/"),
+            # PS registration, internal auth checks, and the metadata
+            # raft transport stay open (peer RPCs carry no credentials;
+            # reference: /register is in the unauthenticated group and
+            # etcd peer traffic is not BasicAuth'd)
+            auth_exempt=("/register", "/auth/check", "/", "/master/raft"),
         )
         s = self.server
         s.route("POST", "/auth/check", self._h_auth_check)
@@ -107,15 +131,173 @@ class MasterServer:
         s.route("GET", "/alias", self._h_get_alias)
         s.route("DELETE", "/alias", self._h_delete_alias)
 
+        if self.replicated:
+            self._setup_meta_raft()
+
+    # -- multi-master plumbing ----------------------------------------------
+
+    def _setup_meta_raft(self) -> None:
+        import os as _os
+
+        from vearch_tpu.cluster.raft import RaftNode
+
+        store = self.store
+
+        def apply(op):
+            # applied-index rides in the same persisted json as the kv
+            # state, so recovery replays exactly the unapplied tail
+            # (next_id is not idempotent — double-replay would skew ids)
+            store.applied_index = self.meta_node.applied + 1
+            return store.apply_op(op)
+
+        def send(peer: int, path: str, body: dict) -> dict:
+            # short timeout: a campaign sends votes sequentially — a
+            # slow peer must not stall the candidate past every other
+            # node's election timer
+            return rpc.call(self.peers[peer], "POST", path, body,
+                            timeout=3.0)
+
+        def snapshot():
+            node = self.meta_node
+            with node._apply_lock:
+                store.applied_index = node.applied
+                return store.snapshot_bytes(), node.applied
+
+        self.meta_node = RaftNode(
+            pid=0, node_id=self.node_id,
+            wal_dir=_os.path.join(self._meta_dir, "meta_raft"),
+            apply_fn=apply, send_fn=send,
+            members=sorted(self.peers),
+            is_leader=False,
+            snapshot_fn=snapshot,
+            install_fn=lambda data, idx: store.install_snapshot(data),
+            quorum_timeout=5.0,
+            election_timeout=self.election_timeout,
+            route_prefix="/master/raft",
+        )
+        self.meta_node.applied = store.applied_index
+        self.meta_node.recover_singleton_commit()
+        self.meta_node._apply_to_commit()
+        store.proposer = lambda op: self.meta_node.propose([op])[0]
+
+        s = self.server
+        s.route("POST", "/master/raft/append",
+                lambda b, p: self.meta_node.handle_append(b))
+        s.route("POST", "/master/raft/vote",
+                lambda b, p: self.meta_node.handle_vote(b))
+        s.route("POST", "/master/raft/snapshot",
+                lambda b, p: self.meta_node.handle_install_snapshot(b))
+        s.route("GET", "/master/raft/state",
+                lambda b, p: self.meta_node.state())
+        self.server.middleware = self._leader_proxy
+
+    def _leader_proxy(self, method, path, body, headers):
+        """Follower middleware: metadata raft RPCs and reads serve
+        locally (replicated store; etcd-style serializable reads);
+        everything else forwards to the current leader."""
+        if not self.replicated or self.is_leader:
+            return None
+        if path.startswith("/master/raft") or method == "GET":
+            return None
+        if headers.get("X-Vearch-Forwarded"):
+            raise RpcError(503, "no metadata leader (forward loop)")
+        hint = self.meta_node.leader_hint
+        if hint is None or hint == self.node_id or hint not in self.peers:
+            raise RpcError(503, "no metadata leader known yet")
+        fwd = {"X-Vearch-Forwarded": "1"}
+        # the client's credentials must travel with the request or the
+        # leader's authenticator rejects every proxied mutation
+        if headers.get("Authorization"):
+            fwd["Authorization"] = headers["Authorization"]
+        return rpc.call(self.peers[hint], method, path, body,
+                        extra_headers=fwd)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.meta_node.is_leader if self.replicated else True
+
+    def _adopt_server_leases(self) -> None:
+        for key, val in self.store.prefix(PREFIX_SERVER).items():
+            nid = int(key[len(PREFIX_SERVER):])
+            old = self._leases.get(nid)
+            if old is not None:
+                # a stale lease from a previous leadership would expire
+                # later and delete the key the fresh lease now owns
+                self.store.revoke_lease(old)
+            lease = self.store.grant_lease(self.heartbeat_ttl)
+            self._leases[nid] = lease
+            self.store.put(key, val, lease=lease)
+
+    def _election_loop(self) -> None:
+        import sys
+
+        keep = 1000  # log tail kept behind meta snapshots
+        last_flush = 0
+        while not self._stop.is_set():
+            time.sleep(max(0.05, self.election_timeout / 4))
+            try:
+                self.meta_node.election_tick()
+                if self.meta_node.is_leader:
+                    # leader heartbeat: resets follower election timers
+                    # and pushes the commit index
+                    self.meta_node.tick()
+                leader_now = self.meta_node.is_leader
+                if leader_now and not self._was_leader:
+                    # promotion work proposes log entries (quorum waits)
+                    # — run it off-thread so heartbeats keep flowing, and
+                    # retry while leadership holds
+                    threading.Thread(target=self._on_promoted,
+                                     daemon=True).start()
+                self._was_leader = leader_now
+                # periodic meta checkpoint + log truncation
+                node = self.meta_node
+                if node.applied - last_flush >= 500:
+                    with node._apply_lock:
+                        self.store.applied_index = node.applied
+                        self.store._persist()
+                        last_flush = node.applied
+                    node.wal.save_meta(fsync=True)
+                    node.wal.truncate_prefix(
+                        max(node.wal.first_index, node.applied - keep + 1)
+                    )
+            except Exception as e:
+                print(f"[master {self.node_id}] election tick failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
+
+    def _on_promoted(self) -> None:
+        """Leadership acquisition: bootstrap auth records and re-lease
+        persisted servers. Retries while we stay leader — each op is a
+        quorum write that can transiently fail during churn."""
+        import sys
+
+        for _ in range(40):
+            if self._stop.is_set() or not self.is_leader:
+                return
+            try:
+                self.auth_service.ensure_bootstrap()
+                self._adopt_server_leases()
+                return
+            except (RpcError, ValueError) as e:
+                # ValueError: wal closed by a concurrent stop()
+                print(f"[master {self.node_id}] promotion work retrying: "
+                      f"{str(e)[:60]}", file=sys.stderr, flush=True)
+                time.sleep(0.3)
+
     def start(self) -> None:
         self.server.start()
         threading.Thread(target=self._lease_reaper, daemon=True).start()
         if self.auto_recover:
             threading.Thread(target=self._auto_recover_loop,
                              daemon=True).start()
+        if self.replicated:
+            threading.Thread(target=self._election_loop,
+                             daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.meta_node is not None:
+            self.meta_node.close()
         self.server.stop()
 
     @property
@@ -125,18 +307,30 @@ class MasterServer:
     # -- failure detection (reference: master_cache.go:963-1005) -------------
 
     def _lease_reaper(self) -> None:
+        import sys
+
         tick = min(1.0, self.heartbeat_ttl / 4)
         while not self._stop.is_set():
             time.sleep(tick)
-            for key in self.store.expire_leases():
-                if key.startswith(PREFIX_SERVER):
-                    # durable FailServer record (reference: master_cache.go
-                    # :963-1005 FailServer) + immediate leader failover
-                    node_id = int(key[len(PREFIX_SERVER):])
-                    self.store.put(f"/fail_server/{node_id}", {
-                        "node_id": node_id, "time": time.time(),
-                    })
-                    self._failover_node(node_id)
+            if not self.is_leader:
+                continue  # leases are leader state
+            try:
+                for key in self.store.expire_leases():
+                    if key.startswith(PREFIX_SERVER):
+                        # durable FailServer record (reference:
+                        # master_cache.go:963-1005) + immediate failover
+                        node_id = int(key[len(PREFIX_SERVER):])
+                        self.store.put(f"/fail_server/{node_id}", {
+                            "node_id": node_id, "time": time.time(),
+                        })
+                        self._failover_node(node_id)
+            except Exception as e:
+                # store mutations propose through the meta log and can
+                # transiently 421/503 during leadership churn — the
+                # failure-detection thread must survive that
+                print(f"[master {self.node_id}] lease reap failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
 
     def _failover_node(self, dead_node: int) -> None:
         """Reconfigure every partition hosted on the dead node: fence all
@@ -220,6 +414,8 @@ class MasterServer:
 
         while not self._stop.is_set():
             time.sleep(1.0)
+            if not self.is_leader:
+                continue
             try:
                 with self._reconfig_lock:
                     self._auto_recover_once()
@@ -411,7 +607,10 @@ class MasterServer:
             lease = self.store.grant_lease(self.heartbeat_ttl)
             self._leases[node_id] = lease
         self.store.put(key, server.to_dict(), lease=lease)
-        self.store.delete(f"/fail_server/{node_id}")
+        if self.store.get(f"/fail_server/{node_id}") is not None:
+            # guarded: an unconditional delete would cost a quorum
+            # proposal on every heartbeat in replicated mode
+            self.store.delete(f"/fail_server/{node_id}")
         return {"node_id": node_id}
 
     def _h_servers(self, _body, _parts) -> dict:

@@ -4,10 +4,16 @@ TPU-native stand-in for the reference's embedded etcd (reference:
 internal/master/server.go:89 embedded etcd; client/master_cache.go watch
 -driven caches; master/store/distlock.go). Same primitives the reference
 leans on — prefix watch, lease-with-TTL liveness, atomic sequences,
-mutex — implemented in-process for the master role. Multi-master
-replication of the metastore itself is a later-round concern (the
-reference delegates it to etcd raft); the interface is shaped so a raft
-log can slide underneath without touching callers.
+mutex.
+
+Replication: every mutation funnels through `_mutate`, which either
+applies directly (single-master mode) or hands the op to a `proposer`
+(the master's metadata raft group — the analogue of etcd's raft).
+`apply_op` is the deterministic state machine executed on every master
+replica in log order; watches fire on every replica so watch-driven
+caches stay fresh cluster-wide. Leases and locks are deliberately
+leader-local (like etcd, lease keepalive is leader state; a new leader
+re-grants leases for persisted keys).
 """
 
 from __future__ import annotations
@@ -26,39 +32,91 @@ class MetaStore:
         self._watches: list[tuple[str, Callable[[str, str, Any], None]]] = []
         self._leases: dict[int, tuple[float, list[str]]] = {}  # id -> (expiry, keys)
         self._next_lease = 1
+        self._locks: dict[str, dict] = {}  # leader-local mutex table
         self._persist_path = persist_path
+        # when set, mutations are proposed to the metadata log instead
+        # of applied locally; the log's apply calls apply_op everywhere
+        self.proposer: Callable[[dict], Any] | None = None
+        self.applied_index = 0  # maintained by the replicated master
         if persist_path:
             os.makedirs(os.path.dirname(persist_path) or ".", exist_ok=True)
             if os.path.exists(persist_path):
                 with open(persist_path) as f:
-                    self._kv = json.load(f)
+                    snap = json.load(f)
+                # legacy snapshots are the bare kv dict
+                if "kv" in snap and isinstance(snap.get("kv"), dict):
+                    self._kv = snap["kv"]
+                    self.applied_index = int(snap.get("applied", 0))
+                else:
+                    self._kv = snap
+
+    # -- mutation funnel ------------------------------------------------------
+
+    def _mutate(self, op: dict) -> Any:
+        if self.proposer is not None:
+            return self.proposer(op)
+        return self.apply_op(op)
+
+    def apply_op(self, op: dict) -> Any:
+        """Deterministic state machine (runs on every master replica)."""
+        t = op.get("t") or op.get("type")  # raft election no-ops use "type"
+        if t == "noop":
+            return None
+        if t == "put":
+            return self._do_put(op["key"], op["value"])
+        if t == "delete":
+            return self._do_delete(op["key"])
+        if t == "next_id":
+            with self._lock:
+                nxt = int(self._kv.get(op["key"], 0)) + 1
+                self._kv[op["key"]] = nxt
+                self._persist()
+                return nxt
+        if t == "cas":
+            with self._lock:
+                if self._kv.get(op["key"]) != op["expect"]:
+                    return False
+                self._kv[op["key"]] = op["value"]
+                self._persist()
+                return True
+        raise ValueError(f"unknown metastore op {t!r}")
+
+    def _do_put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._kv[key] = value
+            self._persist()
+            watchers = [(p, cb) for p, cb in self._watches
+                        if key.startswith(p)]
+        for _, cb in watchers:
+            cb("PUT", key, value)
+
+    def _do_delete(self, key: str) -> bool:
+        with self._lock:
+            existed = key in self._kv
+            self._kv.pop(key, None)
+            self._persist()
+            watchers = [(p, cb) for p, cb in self._watches
+                        if key.startswith(p)]
+        if existed:
+            for _, cb in watchers:
+                cb("DELETE", key, None)
+        return existed
 
     # -- KV ------------------------------------------------------------------
 
     def put(self, key: str, value: Any, lease: int | None = None) -> None:
-        with self._lock:
-            self._kv[key] = value
-            if lease is not None and lease in self._leases:
-                self._leases[lease][1].append(key)
-            self._persist()
-            watchers = [(p, cb) for p, cb in self._watches if key.startswith(p)]
-        for _, cb in watchers:
-            cb("PUT", key, value)
+        self._mutate({"t": "put", "key": key, "value": value})
+        if lease is not None:
+            with self._lock:
+                if lease in self._leases:
+                    self._leases[lease][1].append(key)
 
     def get(self, key: str, default: Any = None) -> Any:
         with self._lock:
             return self._kv.get(key, default)
 
     def delete(self, key: str) -> bool:
-        with self._lock:
-            existed = key in self._kv
-            self._kv.pop(key, None)
-            self._persist()
-            watchers = [(p, cb) for p, cb in self._watches if key.startswith(p)]
-        if existed:
-            for _, cb in watchers:
-                cb("DELETE", key, None)
-        return existed
+        return bool(self._mutate({"t": "delete", "key": key}))
 
     def prefix(self, prefix: str) -> dict[str, Any]:
         with self._lock:
@@ -66,12 +124,9 @@ class MetaStore:
 
     def cas(self, key: str, expect: Any, value: Any) -> bool:
         """Compare-and-swap (reference: etcd STM transactions)."""
-        with self._lock:
-            if self._kv.get(key) != expect:
-                return False
-            self._kv[key] = value
-            self._persist()
-        return True
+        return bool(self._mutate(
+            {"t": "cas", "key": key, "expect": expect, "value": value}
+        ))
 
     # -- watches (reference: client/master_cache.go:414) ---------------------
 
@@ -82,13 +137,9 @@ class MetaStore:
     # -- sequences (reference: etcd sequence for space/partition/node ids) ---
 
     def next_id(self, seq_key: str) -> int:
-        with self._lock:
-            nxt = int(self._kv.get(seq_key, 0)) + 1
-            self._kv[seq_key] = nxt
-            self._persist()
-            return nxt
+        return int(self._mutate({"t": "next_id", "key": seq_key}))
 
-    # -- leases (reference: PS registration lease, server.go:228) ------------
+    # -- leases (leader-local; reference: etcd leases are leader state) ------
 
     def grant_lease(self, ttl_s: float) -> int:
         with self._lock:
@@ -96,6 +147,13 @@ class MetaStore:
             self._next_lease += 1
             self._leases[lease] = (time.time() + ttl_s, [])
             return lease
+
+    def revoke_lease(self, lease: int) -> None:
+        """Drop a lease WITHOUT deleting its keys (used when a new lease
+        supersedes it — e.g. re-adoption after a leader change; letting
+        the stale lease expire would delete keys the new lease owns)."""
+        with self._lock:
+            self._leases.pop(lease, None)
 
     def keepalive(self, lease: int, ttl_s: float) -> bool:
         with self._lock:
@@ -107,7 +165,8 @@ class MetaStore:
     def expire_leases(self) -> list[str]:
         """Drop expired leases; returns the keys deleted (the master's
         failure-detection tick — reference: lease expiry fires the
-        server-watch DELETE, master_cache.go:963)."""
+        server-watch DELETE, master_cache.go:963). The deletions
+        replicate through the log like any other mutation."""
         now = time.time()
         with self._lock:
             dead = [lid for lid, (exp, _) in self._leases.items() if exp < now]
@@ -118,27 +177,43 @@ class MetaStore:
             self.delete(key)
         return doomed
 
-    # -- distributed lock (reference: master/store/distlock.go) --------------
+    # -- distributed lock (leader-local: only the leader executes
+    #    mutating handlers; reference: master/store/distlock.go) ------------
 
     def try_lock(self, name: str, owner: str, ttl_s: float = 30.0) -> bool:
-        key = f"/lock/{name}"
         with self._lock:
-            cur = self._kv.get(key)
-            if cur is not None and cur["expiry"] > time.time() and cur["owner"] != owner:
+            cur = self._locks.get(name)
+            if cur is not None and cur["expiry"] > time.time() \
+                    and cur["owner"] != owner:
                 return False
-            self._kv[key] = {"owner": owner, "expiry": time.time() + ttl_s}
+            self._locks[name] = {"owner": owner,
+                                 "expiry": time.time() + ttl_s}
             return True
 
     def unlock(self, name: str, owner: str) -> None:
-        key = f"/lock/{name}"
         with self._lock:
-            cur = self._kv.get(key)
+            cur = self._locks.get(name)
             if cur is not None and cur["owner"] == owner:
-                self._kv.pop(key, None)
+                self._locks.pop(name, None)
+
+    # -- snapshots (replicated mode: checkpoint + log truncation) ------------
+
+    def snapshot_bytes(self) -> bytes:
+        with self._lock:
+            return json.dumps(
+                {"kv": self._kv, "applied": self.applied_index}
+            ).encode()
+
+    def install_snapshot(self, data: bytes) -> None:
+        snap = json.loads(data)
+        with self._lock:
+            self._kv = snap["kv"]
+            self.applied_index = int(snap.get("applied", 0))
+            self._persist()
 
     def _persist(self) -> None:
         if self._persist_path:
             tmp = self._persist_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(self._kv, f)
+                json.dump({"kv": self._kv, "applied": self.applied_index}, f)
             os.replace(tmp, self._persist_path)

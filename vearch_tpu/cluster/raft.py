@@ -56,6 +56,8 @@ class RaftNode:
         snapshot_fn: Callable[[], tuple[bytes, int]] | None = None,
         install_fn: Callable[[bytes, int], None] | None = None,
         quorum_timeout: float = 10.0,
+        election_timeout: float | None = None,
+        route_prefix: str = "/ps/raft",
     ):
         self.pid = pid
         self.node_id = node_id
@@ -65,6 +67,7 @@ class RaftNode:
         self.snapshot_fn = snapshot_fn
         self.install_fn = install_fn
         self.quorum_timeout = quorum_timeout
+        self.route_prefix = route_prefix
 
         self.members = list(members) if members else [node_id]
         self.is_leader = bool(is_leader)
@@ -82,6 +85,18 @@ class RaftNode:
 
         # incoming snapshot staging: sid -> {chunks, snap_index, term}
         self._snap_in: dict[str, dict] = {}
+
+        # -- voted election mode (metadata groups; data partitions keep
+        # master-arbitrated fencing). Standard raft: randomized timeout,
+        # vote restriction (candidate log must be >= voter's), commit
+        # only entries of the current term by counting (a no-op entry
+        # appended on election carries prior-term entries).
+        self.election_timeout = election_timeout
+        self._last_leader_contact = time.time()
+        self.leader_hint: int | None = node_id if is_leader else None
+        import random
+
+        self._election_jitter = random.uniform(0.8, 1.6)
 
     # -- properties ----------------------------------------------------------
 
@@ -107,6 +122,8 @@ class RaftNode:
                 "commit": self.commit,
                 "applied": self.applied,
                 "is_leader": self.is_leader,
+                "leader_hint": self.node_id if self.is_leader
+                else self.leader_hint,
                 "members": list(self.members),
             }
 
@@ -119,6 +136,8 @@ class RaftNode:
         log and may commit later — at-least-once, ops are idempotent)."""
         with self._propose_lock:
             with self._lock:
+                if self._stopped:
+                    raise RpcError(503, f"partition {self.pid}: stopped")
                 if not self.is_leader:
                     raise RpcError(421, f"partition {self.pid}: not leader")
                 term = self.term
@@ -207,7 +226,7 @@ class RaftNode:
                     return
                 continue
             try:
-                resp = self.send_fn(peer, "/ps/raft/append", {
+                resp = self.send_fn(peer, f"{self.route_prefix}/append", {
                     "pid": self.pid, "term": term, "leader": self.node_id,
                     "prev_index": prev, "prev_term": prev_term,
                     "entries": entries, "commit": commit,
@@ -246,10 +265,18 @@ class RaftNode:
                 reverse=True,
             )
             candidate = indices[self.quorum() - 1]
-            if candidate > self.commit:
-                self.wal.commit_index = candidate
-                self.wal.save_meta()
-                self._commit_cv.notify_all()
+            if candidate <= self.commit:
+                return
+            if self.election_timeout is not None:
+                # voted mode: only count-commit entries of the current
+                # term (raft §5.4.2); the post-election no-op makes
+                # earlier entries commit transitively
+                t = self.wal.term_at(candidate)
+                if t is not None and t != self.term:
+                    return
+            self.wal.commit_index = candidate
+            self.wal.save_meta()
+            self._commit_cv.notify_all()
 
     def _send_snapshot(self, peer: int, term: int) -> bool:
         if self.snapshot_fn is None:
@@ -259,7 +286,7 @@ class RaftNode:
         try:
             for off in range(0, max(len(data), 1), SNAP_CHUNK):
                 chunk = data[off : off + SNAP_CHUNK]
-                resp = self.send_fn(peer, "/ps/raft/snapshot", {
+                resp = self.send_fn(peer, f"{self.route_prefix}/snapshot", {
                     "pid": self.pid, "term": term, "sid": sid,
                     "snap_index": snap_index,
                     "off": off, "total": len(data),
@@ -334,6 +361,8 @@ class RaftNode:
                         "last_index": self.wal.last_index}
             if term > self.term:
                 self._step_down(term)
+            self._last_leader_contact = time.time()
+            self.leader_hint = int(body.get("leader", -1))
             prev_i = int(body["prev_index"])
             prev_t = int(body["prev_term"])
             local_t = self.wal.term_at(prev_i)
@@ -374,6 +403,91 @@ class RaftNode:
             return {"success": True, "term": self.term,
                     "last_index": self.wal.last_index}
 
+    # -- voted elections (metadata groups) -----------------------------------
+
+    def election_tick(self) -> None:
+        """Owner calls this periodically (~timeout/3). Follower whose
+        leader went quiet past the (jittered) timeout campaigns."""
+        if self.election_timeout is None:
+            return
+        with self._lock:
+            if self.is_leader or self._stopped:
+                return
+            quiet = time.time() - self._last_leader_contact
+            if quiet < self.election_timeout * self._election_jitter:
+                return
+            # campaign: bump term, vote for self, reset the clock with a
+            # FRESH jitter draw (raft re-randomizes per round, or two
+            # near-synchronized candidates split votes forever)
+            import random
+
+            self.wal.term += 1
+            term = self.wal.term
+            self.wal.voted_for = self.node_id
+            self.wal.save_meta(fsync=True)
+            self._last_leader_contact = time.time()
+            self._election_jitter = random.uniform(0.8, 1.6)
+            last_index, last_term = self.wal.last_index, self.wal.last_term
+            peers = [m for m in self.members if m != self.node_id]
+        votes = 1
+        for p in peers:
+            try:
+                resp = self.send_fn(p, f"{self.route_prefix}/vote", {
+                    "pid": self.pid, "term": term,
+                    "candidate": self.node_id,
+                    "last_index": last_index, "last_term": last_term,
+                })
+            except RpcError:
+                continue
+            with self._lock:
+                if resp.get("term", 0) > self.term:
+                    self._step_down(resp["term"])
+                    return
+            if resp.get("granted"):
+                votes += 1
+        with self._lock:
+            if self.term != term or self.is_leader:
+                return  # a newer term appeared while counting
+            if votes < self.quorum():
+                return
+            self.is_leader = True
+            self.leader_hint = self.node_id
+            self._match = {}
+            self._next = {
+                p: self.wal.last_index + 1 for p in peers
+            }
+            # no-op of the new term: commits everything before it once
+            # replicated (the standard prior-term commit carrier)
+            self.wal.append([{
+                "index": self.wal.last_index + 1, "term": term,
+                "op": {"type": "noop"},
+            }], fsync=True)
+            self._advance_commit()
+        self._apply_to_commit()
+        self.tick()
+
+    def handle_vote(self, body: dict) -> dict:
+        """RequestVote (raft §5.2 + §5.4.1 up-to-date restriction)."""
+        with self._lock:
+            term = int(body["term"])
+            if term < self.term:
+                return {"granted": False, "term": self.term}
+            if term > self.term:
+                self._step_down(term)
+                self.wal.voted_for = None
+            up_to_date = (
+                (int(body["last_term"]), int(body["last_index"]))
+                >= (self.wal.last_term, self.wal.last_index)
+            )
+            candidate = int(body["candidate"])
+            if up_to_date and self.wal.voted_for in (None, candidate):
+                self.wal.voted_for = candidate
+                self.wal.save_meta(fsync=True)
+                # granting a vote resets our own election clock
+                self._last_leader_contact = time.time()
+                return {"granted": True, "term": self.term}
+            return {"granted": False, "term": self.term}
+
     def handle_fence(self, term: int) -> dict:
         """Master-driven fencing before promotion: adopt the new term
         (rejecting any older leader's appends from now on) and report
@@ -387,6 +501,7 @@ class RaftNode:
         self.is_leader = False
         if term > self.wal.term:
             self.wal.term = term
+            self.wal.voted_for = None  # fresh term, fresh vote
             self.wal.save_meta(fsync=True)
 
     def become_leader(self, term: int, members: list[int]) -> dict:

@@ -126,6 +126,10 @@ class JsonRpcServer:
         # (reference: BasicAuth middleware, cluster_api.go:252)
         self.authenticator = authenticator
         self.auth_exempt = ("/metrics",) + auth_exempt
+        # middleware(method, path, body, headers) -> None to continue,
+        # or a result object served instead of the routed handler (the
+        # multi-master follower->leader proxy hangs here)
+        self.middleware: Callable | None = None
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
             "vearch_request_total", "RPC requests",
@@ -188,6 +192,14 @@ class JsonRpcServer:
                     body = _decode(
                         self.headers.get("Content-Type") or JSON_CT, raw
                     )
+                    if outer.middleware is not None:
+                        short = outer.middleware(
+                            method, self.path.split("?")[0], body,
+                            self.headers,
+                        )
+                        if short is not None:
+                            self._reply(200, {"code": 0, "data": short})
+                            return
                     match = outer._match(method, self.path)
                     handler, parts = match
                     if handler is not None:
@@ -289,17 +301,36 @@ def call(
     body: Any = None,
     timeout: float = 120.0,
     auth: tuple[str, str] | None = None,
+    extra_headers: dict[str, str] | None = None,
 ) -> Any:
     """Client side: raises RpcError on non-zero code. Bodies containing
-    numpy arrays ride the binary tensor codec automatically."""
+    numpy arrays ride the binary tensor codec automatically.
+
+    `addr` may be a comma-separated list (a multi-master endpoint): each
+    address is tried in turn on unreachable/leaderless errors — any
+    master proxies to the current leader, so the first healthy one
+    answers."""
     import base64
 
+    if "," in addr:
+        last: RpcError | None = None
+        for a in addr.split(","):
+            try:
+                return call(a.strip(), method, path, body, timeout, auth,
+                            extra_headers)
+            except RpcError as e:
+                if e.code not in (-1, 503):
+                    raise
+                last = e
+        raise last
     url = f"http://{addr}{path}"
     if body is not None:
         ct, data = _encode(body)
     else:
         ct, data = JSON_CT, None
     headers = {"Content-Type": ct}
+    if extra_headers:
+        headers.update(extra_headers)
     if auth is not None:
         token = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
         headers["Authorization"] = f"Basic {token}"

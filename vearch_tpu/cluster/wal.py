@@ -38,6 +38,7 @@ class Wal:
         self.first_index = 1  # index of the first entry retained in log
         self.term = 0
         self.commit_index = 0
+        self.voted_for: int | None = None  # election mode only
         self._load_meta()
         self._recover()
         self._fd = open(self.path, "ab")
@@ -51,6 +52,7 @@ class Wal:
             self.first_index = int(m.get("first_index", 1))
             self.term = int(m.get("term", 0))
             self.commit_index = int(m.get("commit_index", 0))
+            self.voted_for = m.get("voted_for")
 
     def save_meta(self, fsync: bool = False) -> None:
         with self._lock:
@@ -60,6 +62,7 @@ class Wal:
                     "first_index": self.first_index,
                     "term": self.term,
                     "commit_index": self.commit_index,
+                    "voted_for": self.voted_for,
                 }, f)
                 if fsync:
                     f.flush()
