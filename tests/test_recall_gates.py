@@ -180,3 +180,33 @@ def test_recall_binaryivf():
     r1 = float(np.mean([got[q][0] == gt[q][0] for q in range(nq)]))
     assert r10 >= R_AT_10, f"BINARYIVF recall@10 {r10:.3f}"
     assert r1 >= R_AT_1, f"BINARYIVF recall@1 {r1:.3f}"
+
+
+def test_recall_ivfpq_opq(dataset):
+    """OPQ rotation (reference: gamma_index_ivfpq.h opq_ option) meets
+    the gates and does not lose recall vs plain PQ on the same data."""
+    base, queries, gt = dataset
+    params = {
+        "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
+        "training_threshold": N,
+    }
+    plain = build_engine(IndexParams("IVFPQ", MetricType.L2, params), base)
+    opq = build_engine(
+        IndexParams("IVFPQ", MetricType.L2, {**params, "opq": True}), base
+    )
+    r_plain = recalls(plain, queries, gt, {"rerank": 64})
+    r_opq = recalls(opq, queries, gt, {"rerank": 64})
+    assert_gates(r_opq, "IVFPQ/OPQ")
+    # OPQ refines the quantizer (measured: mirror MSE 0.2815 vs 0.2905
+    # plain at these params) but per-build k-means variance swings
+    # recall@10 by a few points either way — compare with slack
+    assert r_opq[10] >= r_plain[10] - 0.05, (r_plain, r_opq)
+
+    # dump/load round-trips the rotation
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        opq.dump(tmp)
+        eng2 = Engine.open(tmp)
+        r2 = recalls(eng2, queries, gt, {"rerank": 64})
+        assert abs(r2[10] - r_opq[10]) < 0.05

@@ -280,6 +280,10 @@ class IVFPQIndex(_IVFBase):
                 f"{store.dimension}"
             )
         self.ksub = 1 << int(params.get("nbits_per_idx", params.get("nbits", 8)))
+        # optional learned rotation before PQ (reference: OPQ option)
+        self.opq = bool(params.get("opq", False))
+        self.opq_iters = int(params.get("opq_iters", 5))
+        self._opq_R: np.ndarray | None = None  # [d, d] orthonormal
         self.scan_mode = str(params.get("scan_mode", "auto"))
         self.full_scan_limit = int(params.get("full_scan_limit", 16_000_000))
         # one partition spanning the whole device mesh (capacity regime:
@@ -306,6 +310,33 @@ class IVFPQIndex(_IVFBase):
             km.assign_clusters(jnp.asarray(sample), self.centroids)
         )
         resid = sample - np.asarray(self.centroids)[assign]
+        if self.opq:
+            # OPQ (reference: gamma_index_ivfpq.h opq_ option): learn an
+            # orthonormal rotation R that decorrelates subvector energy,
+            # by alternating PQ training on rotated residuals with the
+            # Procrustes update R = UV^T from svd(X^T D(code(XR))).
+            # Downstream stays untouched: codes live in rotated space,
+            # the int8 mirror stores approximations rotated BACK to the
+            # original space, so scan + rerank never see R. On TPU the
+            # rotation is one [d, d] matmul folded into absorb.
+            d = resid.shape[1]
+            R = np.eye(d, dtype=np.float32)
+            for _ in range(self.opq_iters):
+                z = resid @ R
+                self.codebooks = pq_ops.train_pq(
+                    jnp.asarray(z), m=self.m, ksub=self.ksub,
+                    iters=max(self.train_iters // 2, 2),
+                )
+                codes = np.asarray(
+                    pq_ops.encode_pq(jnp.asarray(z), self.codebooks)
+                )
+                decoded = np.asarray(
+                    pq_ops.decode_pq(jnp.asarray(codes), self.codebooks)
+                )
+                u, _s, vt = np.linalg.svd(resid.T @ decoded)
+                R = (u @ vt).astype(np.float32)
+            self._opq_R = R
+            resid = resid @ R
         self.codebooks = pq_ops.train_pq(
             jnp.asarray(resid), m=self.m, ksub=self.ksub,
             iters=self.train_iters,
@@ -317,6 +348,8 @@ class IVFPQIndex(_IVFBase):
     ) -> None:
         cents = np.asarray(self.centroids)
         resid = rows - cents[assign]
+        if self._opq_R is not None:
+            resid = resid @ self._opq_R  # encode in rotated space
         codes = np.asarray(pq_ops.encode_pq(jnp.asarray(resid), self.codebooks))
         if self._codes is None:
             self._codes = np.zeros((0, self.m), dtype=np.uint8)
@@ -329,11 +362,13 @@ class IVFPQIndex(_IVFBase):
         self._codes[start_docid : start_docid + rows.shape[0]] = codes
 
         # docid-ordered int8 mirror for the full-scan path: decode the PQ
-        # approximation, quantize per-row, append
-        cb = np.asarray(self.codebooks)
-        decoded = cb[
-            np.arange(self.m)[None, :], codes.astype(np.int64), :
-        ].reshape(rows.shape[0], -1)
+        # approximation, rotate back to the original space (OPQ), add the
+        # centroid, quantize per-row, append
+        decoded = np.asarray(
+            pq_ops.decode_pq(jnp.asarray(codes), self.codebooks)
+        )
+        if self._opq_R is not None:
+            decoded = decoded @ self._opq_R.T
         approx = cents[assign] + decoded
         self._mirror.append(approx, start=start_docid)
 
@@ -350,21 +385,21 @@ class IVFPQIndex(_IVFBase):
         ids = self._publish_ids()
         cap = ids.shape[1]
         d = self.store.dimension
-        cb = np.asarray(self.codebooks)  # [m, ksub, dsub]
         cents = np.asarray(self.centroids)
         dsub = d // self.m
         resid8 = np.zeros((self.nlist, cap, d), dtype=np.int8)
         scales = np.ones(self.nlist, dtype=np.float32)
         vsq = np.zeros((self.nlist, cap), dtype=np.float32)
-        sub_idx = np.arange(self.m)
         for c, mm in enumerate(self._members):
             if not mm:
                 continue
             rows = np.asarray(mm, dtype=np.int64)
             codes = self._codes[rows]  # [nc, m]
-            decoded = cb[sub_idx[None, :], codes.astype(np.int64), :].reshape(
-                len(mm), d
+            decoded = np.asarray(
+                pq_ops.decode_pq(jnp.asarray(codes), self.codebooks)
             )  # PQ reconstruction of residuals
+            if self._opq_R is not None:
+                decoded = decoded @ self._opq_R.T  # back to original space
             scale = max(float(np.abs(decoded).max()) / 127.0, 1e-12)
             q8 = np.clip(np.rint(decoded / scale), -127, 127).astype(np.int8)
             approx = cents[c][None, :] + scale * q8.astype(np.float32)
@@ -531,8 +566,12 @@ class IVFPQIndex(_IVFBase):
         state = super().dump_state()
         if state and self.codebooks is not None:
             state["codebooks"] = np.asarray(self.codebooks)
+            if self._opq_R is not None:
+                state["opq_R"] = self._opq_R
         return state
 
     def _load_codebooks(self, state: dict[str, Any]) -> None:
         self.codebooks = jnp.asarray(state["codebooks"])
+        if "opq_R" in state:
+            self._opq_R = np.asarray(state["opq_R"], dtype=np.float32)
         self._codes = np.zeros((0, self.m), dtype=np.uint8)
