@@ -149,3 +149,130 @@ def test_refresh_loop_absorbs_in_background(rng):
         time.sleep(0.05)
     assert idx.indexed_count == 320
     eng.close()
+
+
+def test_anti_affinity_placement(tmp_path):
+    """Replica placement honors zone anti-affinity labels (reference:
+    config.go:389 strategies; space_service placement)."""
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+
+    master = MasterServer()
+    master.start()
+    nodes = []
+    zones = ["z1", "z1", "z2", "z2"]
+    for i, z in enumerate(zones):
+        ps = PSServer(data_dir=str(tmp_path / f"ps{i}"),
+                      master_addr=master.addr, labels={"zone": z})
+        ps.start()
+        nodes.append(ps)
+    try:
+        rpc.call(master.addr, "POST", "/dbs/aa")
+        sp = rpc.call(master.addr, "POST", "/dbs/aa/spaces", {
+            "name": "s", "partition_num": 4, "replica_num": 2,
+            "anti_affinity": "zone",
+            "fields": [{"name": "v", "data_type": "vector", "dimension": 4,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        zone_of = {ps.node_id: z for ps, z in zip(nodes, zones)}
+        for p in sp["partitions"]:
+            rep_zones = [zone_of[r] for r in p["replicas"]]
+            assert len(set(rep_zones)) == 2, (p["replicas"], rep_zones)
+    finally:
+        for ps in nodes:
+            ps.stop(flush=False)
+        master.stop()
+
+
+def test_raft_consistent_read_bounces_lagging_replica(tmp_path, rng):
+    """raft_consistent reads 421 off a follower with committed-but-
+    unapplied entries; plain reads still serve (reference:
+    raft_consistent replica lag status, client/client.go:1316)."""
+    import numpy as np
+
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+
+    master = MasterServer()
+    master.start()
+    nodes = [PSServer(data_dir=str(tmp_path / f"ps{i}"),
+                      master_addr=master.addr, heartbeat_interval=0.3)
+             for i in range(2)]
+    for ps in nodes:
+        ps.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    try:
+        rpc.call(master.addr, "POST", "/dbs/rc")
+        sp = rpc.call(master.addr, "POST", "/dbs/rc/spaces", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": 4,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })["partitions"][0]
+        pid, leader_id = sp["id"], sp["leader"]
+        rpc.call(router.addr, "POST", "/document/upsert", {
+            "db_name": "rc", "space_name": "s",
+            "documents": [{"_id": "a", "v": [0.1] * 4}]})
+        follower = next(p for p in nodes
+                        if pid in p.engines and p.node_id != leader_id)
+        node = follower.raft_nodes[pid]
+        # simulate lag: pretend one committed entry is not yet applied
+        real_applied = node.applied
+        node.applied = real_applied - 1
+        body = {"partition_id": pid, "vectors": {"v": [[0.1] * 4]}, "k": 1}
+        with __import__("pytest").raises(rpc.RpcError, match="lags"):
+            rpc.call(follower.addr, "POST", "/ps/doc/search",
+                     {**body, "raft_consistent": True})
+        # plain read still serves from the lagging follower
+        out = rpc.call(follower.addr, "POST", "/ps/doc/search", body)
+        assert out["results"][0][0]["_id"] == "a"
+        node.applied = real_applied
+        # consistent read through the router retries onto the leader
+        hits = rpc.call(router.addr, "POST", "/document/search", {
+            "db_name": "rc", "space_name": "s", "limit": 1,
+            "raft_consistent": True, "load_balance": "not_leader",
+            "vectors": [{"field": "v", "feature": [0.1] * 4}]})
+        assert hits["documents"][0][0]["_id"] == "a"
+    finally:
+        router.stop()
+        for ps in nodes:
+            ps.stop(flush=False)
+        master.stop()
+
+
+def test_backup_cli(tmp_path, rng):
+    """tools/backup CLI round trip (reference: tools/backup)."""
+    import numpy as np
+
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+    from vearch_tpu.tools import backup_cli
+
+    store_root = str(tmp_path / "bk")
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": 4,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": [float(i)] * 4}
+                              for i in range(10)])
+        common = ["--master", c.master_addr, "--db", "db", "--space", "s"]
+        assert backup_cli.main(common + ["create",
+                                         "--store-root", store_root]) == 0
+        assert backup_cli.main(common + ["list",
+                                         "--store-root", store_root]) == 0
+        cl.delete("db", "s", document_ids=[f"d{i}" for i in range(10)])
+        assert backup_cli.main(common + ["restore", "--version", "1",
+                                         "--store-root", store_root]) == 0
+        hits = cl.search("db", "s", [{"field": "v", "feature": [3.0] * 4}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d3"

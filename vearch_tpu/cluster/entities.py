@@ -61,6 +61,9 @@ class Space:
     # ranges ascending; each range backs partition_num slot-sharded
     # partitions (reference: entity/partition.go:125 PartitionRule)
     partition_rule: dict | None = None
+    # replica placement anti-affinity: none|host|rack|zone (reference:
+    # config.go:389 strategies 0-3)
+    anti_affinity: str = "none"
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -74,6 +77,8 @@ class Space:
         }
         if self.partition_rule:
             d["partition_rule"] = self.partition_rule
+        if self.anti_affinity != "none":
+            d["anti_affinity"] = self.anti_affinity
         return d
 
     @classmethod
@@ -87,6 +92,7 @@ class Space:
             replica_num=d.get("replica_num", 1),
             partitions=[Partition.from_dict(p) for p in d.get("partitions", [])],
             partition_rule=d.get("partition_rule"),
+            anti_affinity=d.get("anti_affinity", "none"),
         )
 
     def slot_starts(self) -> list[int]:
@@ -156,6 +162,9 @@ class Server:
     partition_ids: list[int] = field(default_factory=list)
     last_heartbeat: float = field(default_factory=time.time)
     alive: bool = True
+    # topology labels for replica anti-affinity (reference:
+    # config.go:389 strategies 0-3: none/host/rack/zone)
+    labels: dict[str, str] = field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
         return dict(self.__dict__)

@@ -601,6 +601,7 @@ class MasterServer:
             node_id=node_id,
             rpc_addr=body["rpc_addr"],
             partition_ids=(existing or {}).get("partition_ids", []),
+            labels=body.get("labels") or {},
         )
         lease = self._leases.get(node_id)
         if lease is None or not self.store.keepalive(lease, self.heartbeat_ttl):
@@ -862,10 +863,16 @@ class MasterServer:
             if rule is not None:
                 self._validate_rule(rule, schema)
             space_id = self.store.next_id(SEQ_SPACE_ID)
+            anti = str(body.get("anti_affinity", "none"))
+            if anti not in ("none", "host", "rack", "zone"):
+                raise RpcError(
+                    400, f"anti_affinity {anti!r} must be one of "
+                         f"none/host/rack/zone"
+                )
             space = Space(
                 id=space_id, name=name, db_name=db, schema=schema,
                 partition_num=partition_num, replica_num=replica_num,
-                partition_rule=rule,
+                partition_rule=rule, anti_affinity=anti,
             )
             # with a partition rule, every range backs its own group of
             # partition_num slot-sharded partitions (reference: a 3-range
@@ -902,17 +909,44 @@ class MasterServer:
         if vals != sorted(vals) or len(set(vals)) != len(vals):
             raise RpcError(400, "range values must be strictly increasing")
 
+    def _place_replicas(self, space: Space, servers) -> list[int]:
+        """Replica placement: least-loaded with anti-affinity by the
+        space's strategy (reference: config.go:389 none/host/rack/zone;
+        space_service.go:1272 placement). Falls back to allowing label
+        collisions when the topology is too small, like the reference.
+        Load spreads across successive placements because the caller
+        appends to partition_ids between calls."""
+        label = space.anti_affinity
+        chosen: list[int] = []
+        used_labels: set[str] = set()
+        pool = sorted(servers,
+                      key=lambda s: (len(s.partition_ids), s.node_id))
+        for _ in range(space.replica_num):
+            pick = None
+            if label != "none":
+                pick = next(
+                    (s for s in pool
+                     if s.node_id not in chosen
+                     and s.labels.get(label, f"~{s.node_id}")
+                     not in used_labels),
+                    None,
+                )
+            if pick is None:
+                pick = next(
+                    (s for s in pool if s.node_id not in chosen), None
+                )
+            chosen.append(pick.node_id)
+            used_labels.add(pick.labels.get(label, f"~{pick.node_id}"))
+        return chosen
+
     def _create_partition_group(self, space: Space, servers, group) -> None:
         """Create one group of partition_num slot-sharded partitions with
-        round-robin replica placement (reference: space_service.go:141-149)."""
+        anti-affine least-loaded replica placement (reference:
+        space_service.go:141-149)."""
         slots = carve_slots(space.partition_num)
-        offset = len(space.partitions)
         for i in range(space.partition_num):
             pid = self.store.next_id(SEQ_PARTITION_ID)
-            replicas = [
-                servers[(offset + i + r) % len(servers)].node_id
-                for r in range(space.replica_num)
-            ]
+            replicas = self._place_replicas(space, servers)
             part = Partition(
                 id=pid, space_id=space.id, db_name=space.db_name,
                 space_name=space.name, slot=slots[i], replicas=replicas,

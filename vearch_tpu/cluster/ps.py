@@ -50,6 +50,7 @@ class PSServer:
         backup_endpoints: list[str] | None = None,
         flush_interval: float = 5.0,
         raft_tick: float = 0.4,
+        labels: dict[str, str] | None = None,
     ):
         from vearch_tpu.utils import apply_jax_platform_env
 
@@ -94,6 +95,8 @@ class PSServer:
         # escapable by just switching store types (exfiltration/SSRF)
         self.backup_endpoints = backup_endpoints
         self.replication_errors = 0  # surfaced in /ps/stats
+        # topology labels (host/rack/zone) for placement anti-affinity
+        self.labels = dict(labels or {})
         self._peer_cache: tuple[float, dict[int, str]] = (0.0, {})
         # in-flight request registry (reference: handler_document.go:96
         # Rqueue registration for kill + ps/schedule_job.go:252 slow-
@@ -174,7 +177,8 @@ class PSServer:
             try:
                 data = rpc.call(
                     self.master_addr, "POST", "/register",
-                    {"rpc_addr": self.addr, "node_id": self.node_id},
+                    {"rpc_addr": self.addr, "node_id": self.node_id,
+                     "labels": self.labels},
                     auth=self.master_auth,
                 )
                 self.node_id = data["node_id"]
@@ -192,7 +196,8 @@ class PSServer:
             try:
                 rpc.call(
                     self.master_addr, "POST", "/register",
-                    {"rpc_addr": self.addr, "node_id": self.node_id},
+                    {"rpc_addr": self.addr, "node_id": self.node_id,
+                     "labels": self.labels},
                     auth=self.master_auth,
                 )
             except RpcError:
@@ -625,6 +630,25 @@ class PSServer:
                 for i in self._inflight.values()
             ]}
 
+    def _check_read_consistency(self, body: dict) -> None:
+        """raft_consistent reads (reference: client honors the replica's
+        raft_consistent lag status, client/client.go:1316): a follower
+        serving a consistent read must have applied everything it knows
+        to be committed; otherwise the router retries on the leader."""
+        if not body.get("raft_consistent"):
+            return
+        node = self.raft_nodes.get(int(body.get("partition_id", -1)))
+        if node is None:
+            return
+        st = node.state()
+        if not st["is_leader"] and st["applied"] < st["commit"]:
+            raise RpcError(
+                421,
+                f"partition {node.pid}: replica lags (applied "
+                f"{st['applied']} < commit {st['commit']}) for a "
+                f"raft_consistent read",
+            )
+
     def _h_search(self, body: dict, _parts) -> dict:
         import uuid
 
@@ -633,6 +657,7 @@ class PSServer:
         from vearch_tpu.engine.engine import RequestContext, RequestKilled
 
         eng = self._engine(body["partition_id"])
+        self._check_read_consistency(body)
         vectors = {
             name: np.asarray(v, dtype=np.float32)
             for name, v in body["vectors"].items()
@@ -685,6 +710,7 @@ class PSServer:
 
     def _h_query(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])
+        self._check_read_consistency(body)
         vv = bool(body.get("vector_value", False))
         if body.get("document_ids"):
             docs = eng.get(body["document_ids"], body.get("fields"), vv)

@@ -360,6 +360,9 @@ class RouterServer:
             # forwarded so /ps/kill can target queries by the id the
             # client supplied (reference: Rqueue kill by request id)
             "request_id": body.get("request_id"),
+            # consistent reads bounce off lagging replicas (reference:
+            # raft_consistent, client/client.go:1316-1360)
+            "raft_consistent": bool(body.get("raft_consistent", False)),
             "filters": body.get("filters"),
             "include_fields": body.get("fields"),
             "index_params": body.get("index_params") or {},
@@ -440,6 +443,8 @@ class RouterServer:
                 return self._call_partition(
                     skey, pid, "/ps/doc/query",
                     {"document_ids": keys, "fields": body.get("fields"),
+                     "raft_consistent":
+                         bool(body.get("raft_consistent", False)),
                      "vector_value": body.get("vector_value", False)}, lb)
 
             futures = [
@@ -470,7 +475,9 @@ class RouterServer:
                 {"filters": body.get("filters"), "limit": offset + limit,
                  "offset": 0,
                  "fields": body.get("fields"),
-                 "vector_value": body.get("vector_value", False)})
+                 "raft_consistent": bool(body.get("raft_consistent", False)),
+                 "vector_value": body.get("vector_value", False)},
+                body.get("load_balance", "leader"))
 
         futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
         docs = []

@@ -330,9 +330,7 @@ class IVFPQIndex(_IVFBase):
                 codes = np.asarray(
                     pq_ops.encode_pq(jnp.asarray(z), self.codebooks)
                 )
-                decoded = np.asarray(
-                    pq_ops.decode_pq(jnp.asarray(codes), self.codebooks)
-                )
+                decoded = pq_ops.decode_pq_np(codes, self.codebooks)
                 u, _s, vt = np.linalg.svd(resid.T @ decoded)
                 R = (u @ vt).astype(np.float32)
             self._opq_R = R
@@ -364,9 +362,7 @@ class IVFPQIndex(_IVFBase):
         # docid-ordered int8 mirror for the full-scan path: decode the PQ
         # approximation, rotate back to the original space (OPQ), add the
         # centroid, quantize per-row, append
-        decoded = np.asarray(
-            pq_ops.decode_pq(jnp.asarray(codes), self.codebooks)
-        )
+        decoded = pq_ops.decode_pq_np(codes, self.codebooks)
         if self._opq_R is not None:
             decoded = decoded @ self._opq_R.T
         approx = cents[assign] + decoded
@@ -395,9 +391,7 @@ class IVFPQIndex(_IVFBase):
                 continue
             rows = np.asarray(mm, dtype=np.int64)
             codes = self._codes[rows]  # [nc, m]
-            decoded = np.asarray(
-                pq_ops.decode_pq(jnp.asarray(codes), self.codebooks)
-            )  # PQ reconstruction of residuals
+            decoded = pq_ops.decode_pq_np(codes, self.codebooks)
             if self._opq_R is not None:
                 decoded = decoded @ self._opq_R.T  # back to original space
             scale = max(float(np.abs(decoded).max()) / 127.0, 1e-12)

@@ -52,6 +52,19 @@ def encode_pq(x: jax.Array, codebooks: jax.Array) -> jax.Array:
     return assign.T.astype(jnp.uint8)
 
 
+def decode_pq_np(codes: "np.ndarray", codebooks) -> "np.ndarray":
+    """Numpy PQ decode for host-side paths (absorb/publish): avoids a
+    jit dispatch + recompile per distinct batch shape and a device
+    round trip per call — the codebook gather is tiny on host."""
+    import numpy as np
+
+    cb = np.asarray(codebooks)  # [m, ksub, dsub]
+    m = cb.shape[0]
+    return cb[
+        np.arange(m)[None, :], np.asarray(codes).astype(np.int64), :
+    ].reshape(codes.shape[0], -1)
+
+
 @jax.jit
 def decode_pq(codes: jax.Array, codebooks: jax.Array) -> jax.Array:
     """Reconstruct [n, d] from codes [n, m] (for rerank / tests)."""

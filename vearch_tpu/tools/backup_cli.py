@@ -1,0 +1,83 @@
+"""Standalone backup CLI (reference: tools/backup/vearch_backup.go —
+a thin REST wrapper over the master's backup API).
+
+Usage:
+    python -m vearch_tpu.tools.backup_cli \
+        --master host:port --db mydb --space myspace create \
+        --store-root /mnt/backups
+    python -m vearch_tpu.tools.backup_cli ... list --store-root ...
+    python -m vearch_tpu.tools.backup_cli ... restore --version 3 \
+        --s3-endpoint minio:9000 --s3-bucket vearch \
+        --s3-access-key ak --s3-secret-key sk
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_store_spec(args) -> dict:
+    if args.s3_endpoint:
+        spec: dict = {
+            "type": "s3",
+            "endpoint": args.s3_endpoint,
+            "bucket": args.s3_bucket or "vearch",
+            "access_key": args.s3_access_key or "",
+            "secret_key": args.s3_secret_key or "",
+        }
+        if args.s3_region:
+            spec["region"] = args.s3_region
+        if args.s3_prefix:
+            spec["prefix"] = args.s3_prefix
+        return {"store": spec}
+    if not args.store_root:
+        raise SystemExit("need --store-root or --s3-endpoint")
+    return {"store_root": args.store_root}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="vearch-tpu-backup")
+    ap.add_argument("--master", required=True,
+                    help="master address(es), comma-separated for a "
+                         "multi-master group")
+    ap.add_argument("--db", required=True)
+    ap.add_argument("--space", required=True)
+    ap.add_argument("--user", default=None)
+    ap.add_argument("--password", default=None)
+    ap.add_argument("command", choices=["create", "list", "restore"])
+    ap.add_argument("--version", type=int, default=None,
+                    help="backup version (restore)")
+    ap.add_argument("--store-root", default=None,
+                    help="local/NFS object store root")
+    ap.add_argument("--s3-endpoint", default=None)
+    ap.add_argument("--s3-bucket", default=None)
+    ap.add_argument("--s3-access-key", default=None)
+    ap.add_argument("--s3-secret-key", default=None)
+    ap.add_argument("--s3-region", default=None)
+    ap.add_argument("--s3-prefix", default=None)
+    args = ap.parse_args(argv)
+
+    from vearch_tpu.cluster import rpc
+
+    body = {"command": args.command, **build_store_spec(args)}
+    if args.command == "restore":
+        if args.version is None:
+            raise SystemExit("restore needs --version")
+        body["version"] = args.version
+    auth = (args.user, args.password) if args.user else None
+    try:
+        out = rpc.call(
+            args.master, "POST",
+            f"/backup/dbs/{args.db}/spaces/{args.space}", body, auth=auth,
+        )
+    except rpc.RpcError as e:
+        print(f"error ({e.code}): {e.msg}", file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
