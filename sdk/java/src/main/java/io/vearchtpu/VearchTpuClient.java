@@ -1,0 +1,262 @@
+package io.vearchtpu;
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.Base64;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Java client SDK for the vearch-tpu cluster REST surface (route names
+ * mirror upstream vearch; reference: sdk/java public surface). Built on
+ * java.net.http only — bodies are passed and returned as JSON strings
+ * so the SDK stays dependency-free; pair it with any JSON library.
+ *
+ * NOTE: no JDK ships in this build image, so the class is
+ * compile-verified by consumers rather than CI here (docs/PARITY.md).
+ */
+public final class VearchTpuClient {
+
+    /** Server-side error envelope {@code {code, msg}}. */
+    public static final class ApiException extends IOException {
+        public final int code;
+
+        public ApiException(int code, String msg) {
+            super("vearch-tpu: code=" + code + " msg=" + msg);
+            this.code = code;
+        }
+    }
+
+    private final String routerUrl;
+    private final HttpClient http;
+    private String basicAuth;
+
+    /** @param routerUrl e.g. {@code "http://127.0.0.1:8817"} */
+    public VearchTpuClient(String routerUrl) {
+        this.routerUrl = routerUrl.endsWith("/")
+                ? routerUrl.substring(0, routerUrl.length() - 1) : routerUrl;
+        this.http = HttpClient.newBuilder()
+                .connectTimeout(Duration.ofSeconds(10)).build();
+    }
+
+    /** Enables BasicAuth on every request. */
+    public VearchTpuClient withAuth(String user, String password) {
+        this.basicAuth = Base64.getEncoder().encodeToString(
+                (user + ":" + password).getBytes(StandardCharsets.UTF_8));
+        return this;
+    }
+
+    /**
+     * Raw JSON call. Returns the {@code data} member of the response
+     * envelope as a JSON string (or the whole body when no envelope).
+     */
+    public String call(String method, String path, String jsonBody)
+            throws IOException, InterruptedException {
+        HttpRequest.Builder b = HttpRequest.newBuilder()
+                .uri(URI.create(routerUrl + path))
+                .timeout(Duration.ofSeconds(120))
+                .header("Content-Type", "application/json");
+        if (basicAuth != null) {
+            b.header("Authorization", "Basic " + basicAuth);
+        }
+        HttpRequest.BodyPublisher body = jsonBody == null
+                ? HttpRequest.BodyPublishers.noBody()
+                : HttpRequest.BodyPublishers.ofString(jsonBody);
+        HttpRequest req = b.method(method, body).build();
+        HttpResponse<String> resp =
+                http.send(req, HttpResponse.BodyHandlers.ofString());
+        String raw = resp.body();
+        Integer code = extractInt(raw, "\"code\"");
+        if (code == null) {
+            // no envelope (proxy/LB error page): trust the HTTP status —
+            // a 502 body must never read as a successful write
+            if (resp.statusCode() >= 300) {
+                throw new ApiException(resp.statusCode(),
+                        raw.substring(0, Math.min(raw.length(), 200)));
+            }
+            return raw;
+        }
+        if (code != 0) {
+            throw new ApiException(code, extractString(raw, "\"msg\""));
+        }
+        int i = raw.indexOf("\"data\"");
+        if (i < 0) {
+            return raw;
+        }
+        return raw.substring(raw.indexOf(':', i) + 1,
+                raw.lastIndexOf('}')).trim();
+    }
+
+    // -- databases / spaces --------------------------------------------------
+
+    public String createDatabase(String db)
+            throws IOException, InterruptedException {
+        return call("POST", "/dbs/" + db, null);
+    }
+
+    public String dropDatabase(String db)
+            throws IOException, InterruptedException {
+        return call("DELETE", "/dbs/" + db, null);
+    }
+
+    /** @param spaceConfigJson {@code {name, partition_num, fields: [...]}} */
+    public String createSpace(String db, String spaceConfigJson)
+            throws IOException, InterruptedException {
+        return call("POST", "/dbs/" + db + "/spaces", spaceConfigJson);
+    }
+
+    public String getSpace(String db, String space)
+            throws IOException, InterruptedException {
+        return call("GET", "/dbs/" + db + "/spaces/" + space, null);
+    }
+
+    public String dropSpace(String db, String space)
+            throws IOException, InterruptedException {
+        return call("DELETE", "/dbs/" + db + "/spaces/" + space, null);
+    }
+
+    // -- documents -----------------------------------------------------------
+
+    /** @param documentsJson JSON array of documents (each may carry _id) */
+    public String upsert(String db, String space, String documentsJson)
+            throws IOException, InterruptedException {
+        return call("POST", "/document/upsert",
+                "{\"db_name\":" + q(db) + ",\"space_name\":" + q(space)
+                        + ",\"documents\":" + documentsJson + "}");
+    }
+
+    /**
+     * @param vectorsJson JSON array like
+     *   {@code [{"field":"emb","feature":[...]}]} (flattened batch)
+     */
+    public String search(String db, String space, String vectorsJson,
+            int limit, String extraJsonFields)
+            throws IOException, InterruptedException {
+        StringBuilder sb = new StringBuilder()
+                .append("{\"db_name\":").append(q(db))
+                .append(",\"space_name\":").append(q(space))
+                .append(",\"vectors\":").append(vectorsJson)
+                .append(",\"limit\":").append(limit);
+        if (extraJsonFields != null && !extraJsonFields.isEmpty()) {
+            sb.append(',').append(extraJsonFields);
+        }
+        return call("POST", "/document/search", sb.append('}').toString());
+    }
+
+    public String query(String db, String space, List<String> documentIds,
+            String filtersJson, int limit, int offset)
+            throws IOException, InterruptedException {
+        StringBuilder sb = new StringBuilder()
+                .append("{\"db_name\":").append(q(db))
+                .append(",\"space_name\":").append(q(space))
+                .append(",\"limit\":").append(limit)
+                .append(",\"offset\":").append(offset);
+        if (documentIds != null && !documentIds.isEmpty()) {
+            sb.append(",\"document_ids\":[");
+            for (int i = 0; i < documentIds.size(); i++) {
+                if (i > 0) sb.append(',');
+                sb.append(q(documentIds.get(i)));
+            }
+            sb.append(']');
+        }
+        if (filtersJson != null) {
+            sb.append(",\"filters\":").append(filtersJson);
+        }
+        return call("POST", "/document/query", sb.append('}').toString());
+    }
+
+    public String delete(String db, String space, List<String> documentIds,
+            String filtersJson, Integer limit)
+            throws IOException, InterruptedException {
+        StringBuilder sb = new StringBuilder()
+                .append("{\"db_name\":").append(q(db))
+                .append(",\"space_name\":").append(q(space));
+        if (documentIds != null && !documentIds.isEmpty()) {
+            sb.append(",\"document_ids\":[");
+            for (int i = 0; i < documentIds.size(); i++) {
+                if (i > 0) sb.append(',');
+                sb.append(q(documentIds.get(i)));
+            }
+            sb.append(']');
+        }
+        if (filtersJson != null) {
+            sb.append(",\"filters\":").append(filtersJson);
+        }
+        if (limit != null) {
+            sb.append(",\"limit\":").append(limit);
+        }
+        return call("POST", "/document/delete", sb.append('}').toString());
+    }
+
+    // -- index ops -----------------------------------------------------------
+
+    public String flush(String db, String space)
+            throws IOException, InterruptedException {
+        return indexOp("/index/flush", db, space);
+    }
+
+    public String forceMerge(String db, String space)
+            throws IOException, InterruptedException {
+        return indexOp("/index/forcemerge", db, space);
+    }
+
+    public String rebuild(String db, String space)
+            throws IOException, InterruptedException {
+        return indexOp("/index/rebuild", db, space);
+    }
+
+    private String indexOp(String path, String db, String space)
+            throws IOException, InterruptedException {
+        return call("POST", path, "{\"db_name\":" + q(db)
+                + ",\"space_name\":" + q(space) + "}");
+    }
+
+    public boolean isLive() {
+        try {
+            call("GET", "/cluster/health", null);
+            return true;
+        } catch (Exception e) {
+            return false;
+        }
+    }
+
+    // -- helpers -------------------------------------------------------------
+
+    private static String q(String s) {
+        return '"' + s.replace("\\", "\\\\").replace("\"", "\\\"") + '"';
+    }
+
+    /** The numeric value after {@code key}, or null when absent/non-numeric. */
+    private static Integer extractInt(String json, String key) {
+        int i = json.indexOf(key);
+        if (i < 0) return null;
+        int start = json.indexOf(':', i) + 1;
+        int end = start;
+        while (end < json.length()
+                && (Character.isDigit(json.charAt(end))
+                    || json.charAt(end) == '-'
+                    || Character.isWhitespace(json.charAt(end)))) {
+            end++;
+        }
+        String num = json.substring(start, end).trim();
+        if (num.isEmpty()) return null;
+        return Integer.parseInt(num);
+    }
+
+    private static String extractString(String json, String key) {
+        int i = json.indexOf(key);
+        if (i < 0) return "";
+        int start = json.indexOf('"', json.indexOf(':', i)) + 1;
+        int end = start;
+        while (end < json.length() && json.charAt(end) != '"') {
+            if (json.charAt(end) == '\\') end++;
+            end++;
+        }
+        return json.substring(start, end);
+    }
+}

@@ -1,0 +1,244 @@
+//! Rust client SDK for the vearch-tpu cluster REST surface (route names
+//! mirror upstream vearch; reference: sdk/rust public surface). Blocking
+//! HTTP via `ureq`, JSON via `serde_json`.
+//!
+//! NOTE: no Rust toolchain ships in this build image, so this crate is
+//! compile-verified by consumers rather than CI here (docs/PARITY.md).
+
+use serde::{Deserialize, Serialize};
+use serde_json::{json, Value};
+
+/// Server-side error envelope `{code, msg}` or a transport failure.
+#[derive(Debug)]
+pub enum Error {
+    Api { code: i64, msg: String },
+    Transport(String),
+}
+
+impl std::fmt::Display for Error {
+    fn fmt(&self, f: &mut std::fmt::Formatter<'_>) -> std::fmt::Result {
+        match self {
+            Error::Api { code, msg } => {
+                write!(f, "vearch-tpu: code={code} msg={msg}")
+            }
+            Error::Transport(e) => write!(f, "vearch-tpu transport: {e}"),
+        }
+    }
+}
+
+impl std::error::Error for Error {}
+
+pub type Result<T> = std::result::Result<T, Error>;
+
+/// One schema field at space-create time.
+#[derive(Serialize, Deserialize, Debug, Clone, Default)]
+pub struct Field {
+    pub name: String,
+    pub data_type: String,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub dimension: Option<u32>,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub index: Option<Value>,
+}
+
+/// Create-space request body.
+#[derive(Serialize, Deserialize, Debug, Clone, Default)]
+pub struct SpaceConfig {
+    pub name: String,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub partition_num: Option<u32>,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub replica_num: Option<u32>,
+    pub fields: Vec<Field>,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub partition_rule: Option<Value>,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub anti_affinity: Option<String>,
+}
+
+/// One query vector batch (`feature` is the flattened `[b*d]` batch).
+#[derive(Serialize, Deserialize, Debug, Clone)]
+pub struct SearchVector {
+    pub field: String,
+    pub feature: Vec<f32>,
+}
+
+/// Client for a vearch-tpu router (documents) and its master proxy.
+pub struct Client {
+    router_url: String,
+    auth: Option<String>,
+    agent: ureq::Agent,
+}
+
+impl Client {
+    /// `router_url` like `"http://127.0.0.1:8817"`.
+    pub fn new(router_url: impl Into<String>) -> Self {
+        Client {
+            router_url: router_url.into().trim_end_matches('/').to_string(),
+            auth: None,
+            agent: ureq::AgentBuilder::new()
+                .timeout(std::time::Duration::from_secs(120))
+                .build(),
+        }
+    }
+
+    /// Enables BasicAuth on every request.
+    pub fn with_auth(mut self, user: &str, password: &str) -> Self {
+        use base64::Engine as _;
+        let tok = base64::engine::general_purpose::STANDARD
+            .encode(format!("{user}:{password}"));
+        self.auth = Some(format!("Basic {tok}"));
+        self
+    }
+
+    fn call(&self, method: &str, path: &str, body: Option<Value>)
+            -> Result<Value> {
+        let url = format!("{}{}", self.router_url, path);
+        let mut req = self.agent.request(method, &url)
+            .set("Content-Type", "application/json");
+        if let Some(a) = &self.auth {
+            req = req.set("Authorization", a);
+        }
+        let resp = match body {
+            Some(b) => req.send_string(&b.to_string()),
+            None => req.call(),
+        };
+        let (status, text) = match resp {
+            Ok(r) => {
+                let s = r.status();
+                (s, r.into_string()
+                    .map_err(|e| Error::Transport(e.to_string()))?)
+            }
+            Err(ureq::Error::Status(s, r)) => {
+                (s, r.into_string()
+                    .map_err(|e| Error::Transport(e.to_string()))?)
+            }
+            Err(e) => return Err(Error::Transport(e.to_string())),
+        };
+        let v: Value = serde_json::from_str(&text)
+            .map_err(|e| Error::Transport(e.to_string()))?;
+        match v.get("code").and_then(Value::as_i64) {
+            Some(0) => Ok(v.get("data").cloned().unwrap_or(v)),
+            Some(code) => Err(Error::Api {
+                code,
+                msg: v["msg"].as_str().unwrap_or("").to_string(),
+            }),
+            // no envelope (proxy/LB error page): trust the HTTP status —
+            // a 502 JSON body must never read as a successful write
+            None if status < 300 => Ok(v),
+            None => Err(Error::Api {
+                code: i64::from(status),
+                msg: text.chars().take(200).collect(),
+            }),
+        }
+    }
+
+    // -- databases / spaces --------------------------------------------
+
+    pub fn create_database(&self, db: &str) -> Result<Value> {
+        self.call("POST", &format!("/dbs/{db}"), None)
+    }
+
+    pub fn drop_database(&self, db: &str) -> Result<Value> {
+        self.call("DELETE", &format!("/dbs/{db}"), None)
+    }
+
+    pub fn create_space(&self, db: &str, cfg: &SpaceConfig) -> Result<Value> {
+        let body = serde_json::to_value(cfg)
+            .map_err(|e| Error::Transport(e.to_string()))?;
+        self.call("POST", &format!("/dbs/{db}/spaces"), Some(body))
+    }
+
+    pub fn get_space(&self, db: &str, space: &str) -> Result<Value> {
+        self.call("GET", &format!("/dbs/{db}/spaces/{space}"), None)
+    }
+
+    pub fn drop_space(&self, db: &str, space: &str) -> Result<Value> {
+        self.call("DELETE", &format!("/dbs/{db}/spaces/{space}"), None)
+    }
+
+    // -- documents -----------------------------------------------------
+
+    /// `documents`: array of objects, `_id` optional.
+    pub fn upsert(&self, db: &str, space: &str, documents: Value)
+            -> Result<Value> {
+        self.call("POST", "/document/upsert", Some(json!({
+            "db_name": db, "space_name": space, "documents": documents,
+        })))
+    }
+
+    /// Returns `documents`: one hit list per query.
+    pub fn search(&self, db: &str, space: &str, vectors: &[SearchVector],
+                  limit: u32, extra: Option<Value>) -> Result<Value> {
+        let mut body = json!({
+            "db_name": db, "space_name": space,
+            "vectors": vectors, "limit": limit,
+        });
+        if let (Some(obj), Some(Value::Object(ex))) =
+                (body.as_object_mut(), extra) {
+            for (k, v) in ex {
+                obj.insert(k, v);
+            }
+        }
+        self.call("POST", "/document/search", Some(body))
+    }
+
+    pub fn query(&self, db: &str, space: &str, ids: &[&str],
+                 filters: Option<Value>, limit: u32, offset: u32)
+            -> Result<Value> {
+        let mut body = json!({
+            "db_name": db, "space_name": space,
+            "limit": limit, "offset": offset,
+        });
+        let obj = body.as_object_mut().unwrap();
+        if !ids.is_empty() {
+            obj.insert("document_ids".into(), json!(ids));
+        }
+        if let Some(f) = filters {
+            obj.insert("filters".into(), f);
+        }
+        self.call("POST", "/document/query", Some(body))
+    }
+
+    /// `limit`: global delete budget (None = unbounded, 0 = nothing).
+    pub fn delete(&self, db: &str, space: &str, ids: &[&str],
+                  filters: Option<Value>, limit: Option<u64>)
+            -> Result<Value> {
+        let mut body = json!({"db_name": db, "space_name": space});
+        let obj = body.as_object_mut().unwrap();
+        if !ids.is_empty() {
+            obj.insert("document_ids".into(), json!(ids));
+        }
+        if let Some(f) = filters {
+            obj.insert("filters".into(), f);
+        }
+        if let Some(l) = limit {
+            obj.insert("limit".into(), json!(l));
+        }
+        self.call("POST", "/document/delete", Some(body))
+    }
+
+    // -- index ops -----------------------------------------------------
+
+    pub fn flush(&self, db: &str, space: &str) -> Result<Value> {
+        self.index_op("/index/flush", db, space)
+    }
+
+    pub fn force_merge(&self, db: &str, space: &str) -> Result<Value> {
+        self.index_op("/index/forcemerge", db, space)
+    }
+
+    pub fn rebuild(&self, db: &str, space: &str) -> Result<Value> {
+        self.index_op("/index/rebuild", db, space)
+    }
+
+    fn index_op(&self, path: &str, db: &str, space: &str) -> Result<Value> {
+        self.call("POST", path, Some(json!({
+            "db_name": db, "space_name": space,
+        })))
+    }
+
+    pub fn is_live(&self) -> bool {
+        self.call("GET", "/cluster/health", None).is_ok()
+    }
+}

@@ -276,3 +276,50 @@ def test_backup_cli(tmp_path, rng):
         hits = cl.search("db", "s", [{"field": "v", "feature": [3.0] * 4}],
                          limit=1)
         assert hits[0][0]["_id"] == "d3"
+
+
+def test_langchain_integration_surface(tmp_path):
+    """LangChain-style vector store adapter over the SDK (reference:
+    sdk/integrations/langchain) — runs standalone when langchain is not
+    installed (duck-typed Document)."""
+    import os
+    import sys
+
+    sdk_dir = os.path.join(os.path.dirname(__file__), "..", "sdk")
+    sys.path.insert(0, sdk_dir)
+    try:
+        from integrations.langchain_vearch_tpu import VearchTpuVectorStore
+    finally:
+        sys.path.remove(sdk_dir)
+
+    import numpy as np
+
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    def toy_embedding(texts):
+        # deterministic 8-dim bag-of-chars embedding
+        out = []
+        for t in texts:
+            v = np.zeros(8, np.float32)
+            for i, ch in enumerate(t.encode()):
+                v[i % 8] += ch / 100.0
+            out.append(v.tolist())
+        return out
+
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1) as c:
+        store = VearchTpuVectorStore(
+            VearchClient(c.router_addr), "lcdb", "lcspace", toy_embedding)
+        ids = store.add_texts(
+            ["the quick brown fox", "jumps over", "the lazy dog"],
+            metadatas=[{"src": "a"}, {"src": "b"}, {"src": "c"}],
+        )
+        assert len(ids) == 3
+        docs = store.similarity_search("the quick brown fox", k=1)
+        assert docs[0].page_content == "the quick brown fox"
+        assert docs[0].metadata["src"] == "a"
+        pairs = store.similarity_search_with_score("jumps over", k=2)
+        assert pairs[0][0].page_content == "jumps over"
+        assert store.delete([ids[0]])
+        docs = store.similarity_search("the quick brown fox", k=3)
+        assert all(d.page_content != "the quick brown fox" for d in docs)
