@@ -115,14 +115,15 @@ class VearchTpuVectorStore(VectorStore):
         import json
 
         texts = list(texts)
-        vectors = self._embed_documents(texts)
         ids = ids or [uuid.uuid4().hex for _ in texts]
         metadatas = metadatas or [{} for _ in texts]
         if len(ids) != len(texts) or len(metadatas) != len(texts):
+            # validate BEFORE embedding: the embed call may be a paid API
             raise ValueError(
                 f"length mismatch: {len(texts)} texts, {len(ids)} ids, "
                 f"{len(metadatas)} metadatas"
             )
+        vectors = self._embed_documents(texts)
         docs = [
             {"_id": i, self.text_field: t, "metadata": json.dumps(m),
              self.vector_field: v}
@@ -185,7 +186,8 @@ class VearchTpuVectorStore(VectorStore):
         space_name: str = "langchain",
         **kwargs: Any,
     ) -> "VearchTpuVectorStore":
-        assert client is not None, "pass client=VearchClient(router_addr)"
+        if client is None:  # not assert: must survive python -O
+            raise ValueError("pass client=VearchClient(router_addr)")
         store = cls(client, db_name, space_name, embedding, **kwargs)
         store.add_texts(texts, metadatas)
         return store

@@ -220,6 +220,9 @@ public final class VearchTpuClient {
         try {
             call("GET", "/cluster/health", null);
             return true;
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();  // preserve cancellation
+            return false;
         } catch (Exception e) {
             return false;
         }
@@ -228,7 +231,24 @@ public final class VearchTpuClient {
     // -- helpers -------------------------------------------------------------
 
     private static String q(String s) {
-        return '"' + s.replace("\\", "\\\\").replace("\"", "\\\"") + '"';
+        StringBuilder sb = new StringBuilder(s.length() + 2).append('"');
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '\\': sb.append("\\\\"); break;
+                case '"': sb.append("\\\""); break;
+                case '\n': sb.append("\\n"); break;
+                case '\r': sb.append("\\r"); break;
+                case '\t': sb.append("\\t"); break;
+                default:
+                    if (c < 0x20) {
+                        sb.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        sb.append(c);
+                    }
+            }
+        }
+        return sb.append('"').toString();
     }
 
     /** The numeric value after {@code key}, or null when absent/non-numeric. */
