@@ -170,7 +170,60 @@ def test_composite_index_equality_and(rng):
     }
     # the composite actually got used (sanity on the planner)
     ci = eng._scalar_manager.composite_for({"brand", "color"})
-    assert ci is not None and ci._index
+    assert ci is not None and ci._rows
+
+
+def test_composite_prefix_and_range_scan(rng):
+    """Composite-key semantics (reference: composite_index.h ordered
+    multi-column keys): equality on a prefix of the member fields plus a
+    range on the next member resolves in the composite, not per-field."""
+    schema = TableSchema(
+        name="comp3",
+        fields=[
+            FieldSchema("brand", DataType.STRING),
+            FieldSchema("price", DataType.FLOAT),
+            FieldSchema("emb", DataType.VECTOR, dimension=D,
+                        index=IndexParams("FLAT", MetricType.L2)),
+        ],
+        composite_indexes=[["brand", "price"]],
+    )
+    eng = Engine(schema)
+    vecs = rng.standard_normal((120, D)).astype(np.float32)
+    eng.upsert([
+        {"_id": f"d{i}", "brand": f"b{i % 3}", "price": float(i % 40),
+         "emb": vecs[i]}
+        for i in range(120)
+    ])
+
+    def hits(flt):
+        res = eng.search(SearchRequest(vectors={"emb": vecs[:1]}, k=120,
+                                       filters=flt))
+        return {it.key for it in res[0].items}
+
+    # prefix equality + range on the next member field
+    flt = {"operator": "AND", "conditions": [
+        {"field": "brand", "operator": "=", "value": "b1"},
+        {"field": "price", "operator": "<", "value": 10},
+    ]}
+    assert hits(flt) == {f"d{i}" for i in range(120)
+                         if i % 3 == 1 and (i % 40) < 10}
+    # >= variant
+    flt["conditions"][1] = {"field": "price", "operator": ">=", "value": 30}
+    assert hits(flt) == {f"d{i}" for i in range(120)
+                         if i % 3 == 1 and (i % 40) >= 30}
+    # prefix-only equality (brand alone) also rides the composite
+    flt = {"operator": "AND", "conditions": [
+        {"field": "brand", "operator": "=", "value": "b2"},
+    ]}
+    assert hits(flt) == {f"d{i}" for i in range(120) if i % 3 == 2}
+    # a band: composite consumes one bound, per-field handles the other
+    flt = {"operator": "AND", "conditions": [
+        {"field": "brand", "operator": "=", "value": "b0"},
+        {"field": "price", "operator": ">", "value": 5},
+        {"field": "price", "operator": "<=", "value": 15},
+    ]}
+    assert hits(flt) == {f"d{i}" for i in range(120)
+                         if i % 3 == 0 and 5 < (i % 40) <= 15}
 
 
 def test_composite_survives_dump_load(rng, tmp_path):

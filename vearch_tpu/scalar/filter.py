@@ -128,14 +128,39 @@ def evaluate_filter(flt, engine, n: int) -> np.ndarray:
     masks: list[np.ndarray] = []
     mgr = engine._scalar_manager
     if flt.operator == "AND" and mgr is not None:
-        eq = [c for c in conditions if c.operator == "="]
-        ci = mgr.composite_for({c.field for c in eq}) if eq else None
-        if ci is not None:
-            by_field = {c.field: c.value for c in eq}
-            masks.append(ci.query_equalities(
-                tuple(by_field[f] for f in ci.fields), n
+        # composite planning (reference: composite-key semantics): the
+        # best composite serves the longest '=' prefix of its member
+        # fields plus at most one range condition on the field right
+        # after the prefix; leftover conditions evaluate per-field
+        eq_by_field = {c.field: c for c in conditions if c.operator == "="}
+        range_by_field: dict[str, Condition] = {}
+        for c in conditions:
+            if c.operator in ("<", "<=", ">", ">="):
+                range_by_field.setdefault(c.field, c)
+        best = None  # (covered_count, ci, prefix_fields, range_cond)
+        for ci in mgr.composites():
+            prefix = []
+            for f in ci.fields:
+                if f in eq_by_field:
+                    prefix.append(f)
+                else:
+                    break
+            rc = None
+            if len(prefix) < len(ci.fields):
+                rc = range_by_field.get(ci.fields[len(prefix)])
+            covered = len(prefix) + (1 if rc is not None else 0)
+            if covered and (best is None or covered > best[0]):
+                best = (covered, ci, prefix, rc)
+        if best is not None:
+            _, ci, prefix, rc = best
+            masks.append(ci.query_prefix(
+                tuple(eq_by_field[f].value for f in prefix), rc, n
             ))
-            conditions = [c for c in conditions if c not in eq]
+            consumed_ids = {id(eq_by_field[f]) for f in prefix}
+            if rc is not None:
+                consumed_ids.add(id(rc))
+            conditions = [c for c in conditions
+                          if id(c) not in consumed_ids]
 
     masks.extend(evaluate_condition(c, engine, n) for c in conditions)
     out = masks[0].copy()

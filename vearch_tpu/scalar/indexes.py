@@ -31,31 +31,38 @@ class InvertedScalarIndex:
     """
 
     def __init__(self, dtype: np.dtype):
+        import threading
+
         self.dtype = dtype
         self._values = np.zeros(0, dtype=dtype)
         self._docids = np.zeros(0, dtype=np.int64)
         self._pending_values: list[Any] = []
         self._pending_docids: list[int] = []
         self._sorted = True
+        # lazy sorting mutates at QUERY time: concurrent searches /
+        # upserts must not interleave with the re-sort
+        self._sort_lock = threading.Lock()
 
     def add(self, value: Any, docid: int) -> None:
-        self._pending_values.append(value)
-        self._pending_docids.append(docid)
+        with self._sort_lock:
+            self._pending_values.append(value)
+            self._pending_docids.append(docid)
 
     def _ensure_sorted(self) -> None:
-        if self._pending_values:
-            v = np.asarray(self._pending_values, dtype=self.dtype)
-            d = np.asarray(self._pending_docids, dtype=np.int64)
-            self._values = np.concatenate([self._values, v])
-            self._docids = np.concatenate([self._docids, d])
-            self._pending_values.clear()
-            self._pending_docids.clear()
-            self._sorted = False
-        if not self._sorted:
-            order = np.argsort(self._values, kind="stable")
-            self._values = self._values[order]
-            self._docids = self._docids[order]
-            self._sorted = True
+        with self._sort_lock:
+            if self._pending_values:
+                v = np.asarray(self._pending_values, dtype=self.dtype)
+                d = np.asarray(self._pending_docids, dtype=np.int64)
+                self._values = np.concatenate([self._values, v])
+                self._docids = np.concatenate([self._docids, d])
+                self._pending_values.clear()
+                self._pending_docids.clear()
+                self._sorted = False
+            if not self._sorted:
+                order = np.argsort(self._values, kind="stable")
+                self._values = self._values[order]
+                self._docids = self._docids[order]
+                self._sorted = True
 
     def query(self, cond: Condition, n: int) -> np.ndarray:
         self._ensure_sorted()
@@ -94,27 +101,91 @@ class InvertedScalarIndex:
 
 
 class CompositeScalarIndex:
-    """Multi-column index for conjunctive equality filters (reference:
+    """Multi-column index over sorted composite keys (reference:
     table/composite_index.h:38 — multi-column RocksDB keys; the manager's
     composite strategy, scalar_index_manager.h:27).
 
-    Keyed by the tuple of the member fields' values: an AND filter whose
-    equality conditions cover exactly the member fields resolves in one
-    dict lookup instead of intersecting per-field masks. Range/term
-    conditions fall back to the per-field path.
+    Rows sort lexicographically by the member fields' values, so — like
+    an ordered RocksDB key scan — one lookup serves:
+    - equality on any PREFIX of the member fields, and
+    - optionally one range condition on the NEXT field after the prefix
+    (classic composite-key semantics). Everything else falls back to the
+    per-field path in the planner.
     """
 
     def __init__(self, fields: list[str]):
+        import threading
+
         self.fields = list(fields)
-        self._index: dict[tuple, list[int]] = {}
+        self._rows: list[tuple] = []  # (v1, ..., vk, docid)
+        self._sorted = True
+        # the lazy sort mutates _rows at QUERY time; list.sort detaches
+        # the list mid-sort, so an unsynchronized concurrent search
+        # would silently see an empty index and a concurrent add would
+        # raise "list modified during sort"
+        self._sort_lock = threading.Lock()
 
     def add(self, values: tuple, docid: int) -> None:
-        self._index.setdefault(tuple(values), []).append(docid)
+        with self._sort_lock:
+            self._rows.append(tuple(values) + (docid,))
+            self._sorted = False
 
-    def query_equalities(self, values: tuple, n: int) -> np.ndarray:
+    def _ensure_sorted(self) -> None:
+        with self._sort_lock:
+            if not self._sorted:
+                self._rows.sort(key=lambda t: t[:-1])
+                self._sorted = True
+
+    def _prefix_bounds(self, lo: int, hi: int, col: int, value,
+                       side_left: bool) -> int:
+        """Binary search within rows[lo:hi] on column `col` (rows are
+        sorted on that column inside an equal prefix)."""
+        rows = self._rows
+        while lo < hi:
+            mid = (lo + hi) // 2
+            v = rows[mid][col]
+            if v < value or (not side_left and v == value):
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def query_prefix(self, eq_values: tuple, range_cond: "Condition | None",
+                     n: int) -> np.ndarray:
+        """Mask for (field1 = v1 AND ... AND fieldp = vp [AND
+        field{p+1} <op> w]) with p = len(eq_values). A probe value whose
+        type cannot be compared with the stored values matches nothing
+        (the dict-index behavior this replaces), never crashes."""
+        self._ensure_sorted()
         mask = np.zeros(n, dtype=bool)
-        ids = np.asarray(self._index.get(tuple(values), []), dtype=np.int64)
-        mask[ids[ids < n]] = True
+        lo, hi = 0, len(self._rows)
+        try:
+            for col, v in enumerate(eq_values):
+                lo = self._prefix_bounds(lo, hi, col, v, side_left=True)
+                hi = self._prefix_bounds(lo, hi, col, v, side_left=False)
+            if range_cond is not None and lo < hi:
+                col = len(eq_values)
+                op, w = range_cond.operator, range_cond.value
+                if op == "<":
+                    hi = self._prefix_bounds(lo, hi, col, w, side_left=True)
+                elif op == "<=":
+                    hi = self._prefix_bounds(lo, hi, col, w, side_left=False)
+                elif op == ">":
+                    lo = self._prefix_bounds(lo, hi, col, w, side_left=False)
+                elif op == ">=":
+                    lo = self._prefix_bounds(lo, hi, col, w, side_left=True)
+                else:
+                    raise ValueError(
+                        f"composite range does not support {op!r}"
+                    )
+        except TypeError:
+            return mask  # incomparable probe value: no matches
+        if lo < hi:
+            ids = np.fromiter(
+                (t[-1] for t in self._rows[lo:hi]), dtype=np.int64,
+                count=hi - lo,
+            )
+            mask[ids[ids < n]] = True
         return mask
 
 

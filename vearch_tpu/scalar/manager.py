@@ -51,10 +51,13 @@ class ScalarIndexManager:
     def has_index(self, field: str) -> bool:
         return field in self._indexes
 
+    def composites(self) -> list:
+        """Declared composite indexes, for the filter planner
+        (reference: scalar_index_manager.h FilterIndexPair)."""
+        return list(self._composites)
+
     def composite_for(self, fields: set[str]):
-        """A composite index whose member set equals `fields`, if any
-        (the manager's filter planning step — reference:
-        scalar_index_manager.h FilterIndexPair)."""
+        """A composite index whose member set equals `fields`, if any."""
         for ci in self._composites:
             if set(ci.fields) == fields:
                 return ci
@@ -67,7 +70,10 @@ class ScalarIndexManager:
                     index.add(doc[name], base_docid + i)
         for ci in self._composites:
             for i, doc in enumerate(docs):
-                if all(f in doc for f in ci.fields):
+                # None members are unorderable in the sorted composite
+                # rows — skip them, like the reference skips docs
+                # missing composite member columns
+                if all(doc.get(f) is not None for f in ci.fields):
                     ci.add(tuple(doc[f] for f in ci.fields), base_docid + i)
 
     def query(self, cond: Condition, n: int) -> np.ndarray:
@@ -91,4 +97,6 @@ class ScalarIndexManager:
             cols = {f: column_rows(f) for f in ci.fields}
             count = min(len(v) for v in cols.values()) if cols else 0
             for docid in range(count):
-                ci.add(tuple(cols[f][docid] for f in ci.fields), docid)
+                values = tuple(cols[f][docid] for f in ci.fields)
+                if all(v is not None for v in values):  # match add_docs
+                    ci.add(values, docid)
