@@ -64,32 +64,19 @@ class VearchTpuVectorStore(VectorStore):
         self.text_field = text_field
         self.vector_field = vector_field
         if create:
-            from vearch_tpu.cluster.rpc import RpcError
+            from . import ensure_space
 
             if dimension is None:
                 dimension = len(self._embed_query("dimension probe"))
-            try:
-                client.create_database(db_name)
-            except RpcError as e:
-                if e.code != 409:  # anything but already-exists is real
-                    raise
-            try:
-                client.create_space(db_name, {
-                    "name": space_name,
-                    "partition_num": 1,
-                    "fields": [
-                        {"name": text_field, "data_type": "string"},
-                        {"name": "metadata", "data_type": "string"},
-                        {"name": vector_field, "data_type": "vector",
-                         "dimension": dimension,
-                         "index": {"index_type": index_type,
-                                   "metric_type": metric_type,
-                                   "params": index_params or {}}},
-                    ],
-                })
-            except RpcError as e:
-                if e.code != 409:
-                    raise
+            ensure_space(client, db_name, space_name, [
+                {"name": text_field, "data_type": "string"},
+                {"name": "metadata", "data_type": "string"},
+                {"name": vector_field, "data_type": "vector",
+                 "dimension": dimension,
+                 "index": {"index_type": index_type,
+                           "metric_type": metric_type,
+                           "params": index_params or {}}},
+            ])
 
     # -- embedding dispatch --------------------------------------------------
 

@@ -323,3 +323,146 @@ def test_langchain_integration_surface(tmp_path):
         assert store.delete([ids[0]])
         docs = store.similarity_search("the quick brown fox", k=3)
         assert all(d.page_content != "the quick brown fox" for d in docs)
+
+
+def test_llamaindex_integration_surface(tmp_path):
+    """LlamaIndex-protocol vector store (reference:
+    sdk/integrations/llama-index) — standalone duck-typed fallback."""
+    import os
+    import sys
+
+    sdk_dir = os.path.join(os.path.dirname(__file__), "..", "sdk")
+    sys.path.insert(0, sdk_dir)
+    try:
+        from integrations.llamaindex_vearch_tpu import (
+            TextNode, VearchTpuLlamaVectorStore, VectorStoreQuery,
+        )
+    finally:
+        sys.path.remove(sdk_dir)
+
+    import numpy as np
+
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    rng = np.random.default_rng(2)
+    embs = rng.standard_normal((3, 8)).astype(np.float32)
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1) as c:
+        store = VearchTpuLlamaVectorStore(
+            VearchClient(c.router_addr), "lidb", "lispace", dimension=8)
+        nodes = [
+            TextNode(text=f"text {i}", id_=f"n{i}",
+                     embedding=embs[i].tolist(), metadata={"i": i})
+            for i in range(3)
+        ]
+        assert store.add(nodes) == ["n0", "n1", "n2"]
+        res = store.query(VectorStoreQuery(
+            query_embedding=embs[1].tolist(), similarity_top_k=2))
+        assert res.ids[0] == "n1"
+        assert res.nodes[0].get_content() == "text 1"
+        assert res.nodes[0].metadata == {"i": 1}
+        store.delete_nodes(["n1"])
+        res = store.query(VectorStoreQuery(
+            query_embedding=embs[1].tolist(), similarity_top_k=3))
+        assert "n1" not in res.ids
+
+
+def test_debug_profile_endpoint(tmp_path):
+    """Sampling CPU profile endpoint (reference: pprof UI profiles)."""
+    import threading
+    import time
+    import urllib.request
+
+    from vearch_tpu.cluster.master import MasterServer
+
+    master = MasterServer()
+    master.start()
+    stop = threading.Event()
+
+    def burn():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=burn, daemon=True)
+    t.start()
+    try:
+        out = urllib.request.urlopen(
+            f"http://{master.addr}/debug/profile?seconds=0.5", timeout=10
+        ).read().decode()
+        assert "sampling profile" in out
+        assert "hottest frames" in out and "burn" in out, out[:400]
+    finally:
+        stop.set()
+        master.stop()
+
+
+def test_llamaindex_ref_doc_delete_and_profile_auth(tmp_path):
+    """delete(ref_doc_id) purges every node of the document; the debug
+    endpoints require credentials on an auth-enabled master."""
+    import os
+    import sys
+    import urllib.error
+    import urllib.request
+
+    sdk_dir = os.path.join(os.path.dirname(__file__), "..", "sdk")
+    sys.path.insert(0, sdk_dir)
+    try:
+        from integrations.llamaindex_vearch_tpu import (
+            TextNode, VearchTpuLlamaVectorStore, VectorStoreQuery,
+        )
+    finally:
+        sys.path.remove(sdk_dir)
+
+    import numpy as np
+
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    rng = np.random.default_rng(4)
+    embs = rng.standard_normal((4, 8)).astype(np.float32)
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1) as c:
+        store = VearchTpuLlamaVectorStore(
+            VearchClient(c.router_addr), "li2", "s", dimension=8)
+        nodes = []
+        for i in range(4):
+            n = TextNode(text=f"t{i}", id_=f"n{i}",
+                         embedding=embs[i].tolist())
+            n.ref_doc_id = "docA" if i < 2 else "docB"
+            nodes.append(n)
+        store.add(nodes)
+        store.delete("docA")  # document-level: removes n0 and n1
+        res = store.query(VectorStoreQuery(
+            query_embedding=embs[0].tolist(), similarity_top_k=4))
+        assert set(res.ids) == {"n2", "n3"}, res.ids
+        # unsupported metadata filters are loud, not silent
+        q = VectorStoreQuery(query_embedding=embs[0].tolist(),
+                             similarity_top_k=1)
+        q.filters = {"anything": 1}
+        with pytest.raises(ValueError, match="MetadataFilters"):
+            store.query(q)
+
+    master = MasterServer(auth=True, root_password="pw")
+    master.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://{master.addr}/debug/profile?seconds=0.1", timeout=10
+        ).read().decode()
+        assert '"code": 401' in body, body[:120]  # unauthenticated -> 401
+        import base64
+
+        req = urllib.request.Request(
+            f"http://{master.addr}/debug/profile?seconds=0.1",
+            headers={"Authorization": "Basic " + base64.b64encode(
+                b"root:pw").decode()})
+        body = urllib.request.urlopen(req, timeout=10).read().decode()
+        assert "sampling profile" in body
+        # malformed seconds is a clean 400, not a connection reset
+        req2 = urllib.request.Request(
+            f"http://{master.addr}/debug/profile?seconds=abc",
+            headers={"Authorization": "Basic " + base64.b64encode(
+                b"root:pw").decode()})
+        body = urllib.request.urlopen(req2, timeout=10).read().decode()
+        assert '"code": 400' in body
+    finally:
+        master.stop()

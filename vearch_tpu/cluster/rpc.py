@@ -106,6 +106,47 @@ class RpcError(Exception):
         self.msg = msg
 
 
+def _sample_profile(seconds: float, interval: float = 0.01) -> str:
+    """Stdlib sampling profiler: aggregate thread stacks over a window
+    (the pprof-CPU-profile analogue; py-spy-style, no native deps).
+    Returns a text report of the hottest (function, file:line) frames
+    and the hottest full stacks."""
+    import collections
+    import sys
+
+    me = threading.get_ident()
+    frame_counts: collections.Counter = collections.Counter()
+    stack_counts: collections.Counter = collections.Counter()
+    samples = 0
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 40:
+                co = f.f_code
+                entry = f"{co.co_name} ({co.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})"
+                stack.append(entry)
+                f = f.f_back
+            if stack:
+                frame_counts[stack[0]] += 1
+                stack_counts[" <- ".join(stack[:10])] += 1
+        samples += 1
+        time.sleep(interval)
+    lines = [f"# sampling profile: {seconds:.1f}s, {samples} samples, "
+             f"{interval * 1e3:.0f}ms interval", "",
+             "## hottest frames (leaf)"]
+    for entry, cnt in frame_counts.most_common(25):
+        lines.append(f"{cnt / max(samples, 1) * 100:6.1f}%  {entry}")
+    lines.append("")
+    lines.append("## hottest stacks")
+    for stack, cnt in stack_counts.most_common(10):
+        lines.append(f"{cnt / max(samples, 1) * 100:6.1f}%  {stack}")
+    return "\n".join(lines)
+
+
 class JsonRpcServer:
     """Route table of (method, path-prefix) -> handler(body, path_parts).
 
@@ -150,9 +191,43 @@ class JsonRpcServer:
             def _serve(self, method: str):
                 plain_path = self.path.split("?")[0]
                 if method == "GET" and plain_path in ("/metrics",
-                                                      "/debug/stacks"):
+                                                      "/debug/stacks",
+                                                      "/debug/profile"):
+                    # /metrics stays open (scrapers); the debug
+                    # endpoints burn CPU / dump internals, so they go
+                    # through the authenticator like any other route
+                    if (plain_path.startswith("/debug")
+                            and outer.authenticator is not None):
+                        try:
+                            outer.authenticator(self.headers, method,
+                                                plain_path)
+                        except RpcError as e:
+                            self._reply(200, {"code": e.code,
+                                              "msg": e.msg})
+                            return
                     if plain_path == "/metrics":
                         data = outer.metrics.render().encode()
+                    elif plain_path == "/debug/profile":
+                        # sampling CPU profile (reference: pprof UI CPU
+                        # profiles, debugutil/): sample all thread
+                        # stacks for ?seconds=N, render hot frames
+                        from urllib.parse import parse_qs, urlparse
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        try:
+                            secs = min(
+                                float(qs.get("seconds", ["2"])[0]), 30.0
+                            )
+                            if not (secs == secs and secs >= 0):  # NaN/neg
+                                raise ValueError(secs)
+                        except (TypeError, ValueError):
+                            self._reply(200, {
+                                "code": 400,
+                                "msg": "seconds must be a number in "
+                                       "[0, 30]",
+                            })
+                            return
+                        data = _sample_profile(secs).encode()
                     else:
                         # pprof-style live thread dump (reference:
                         # debugutil/pprofui goroutine profiles)
