@@ -16,13 +16,16 @@ from vearch_tpu.sdk.client import VearchClient
 D = 8
 
 
-def make_masters(tmp_path, n=3, timeout=1.0):
+def make_masters(tmp_path, n=3, timeout=1.0, _attempt=0):
     ids = list(range(1, n + 1))
     masters = []
+    # per-attempt subdirectory: a retry must not share persist/WAL files
+    # with the failed attempt's (possibly still winding down) threads
+    base = tmp_path / f"attempt{_attempt}"
     for i in ids:
         m = MasterServer(
-            persist_path=str(tmp_path / f"m{i}" / "meta.json"),
-            meta_dir=str(tmp_path / f"m{i}"),
+            persist_path=str(base / f"m{i}" / "meta.json"),
+            meta_dir=str(base / f"m{i}"),
             node_id=i, peers={j: "" for j in ids},
             election_timeout=timeout, heartbeat_ttl=2.0,
         )
@@ -32,6 +35,19 @@ def make_masters(tmp_path, n=3, timeout=1.0):
         m.peers = dict(addrs)
     for m in masters:
         m.start()
+    # debounce a slow first election (single-CPU CI boxes starve the
+    # tick threads under load): one clean rebuild before failing
+    try:
+        wait_leader(masters)
+    except AssertionError:
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+        if _attempt >= 1:
+            raise
+        return make_masters(tmp_path, n, timeout, _attempt + 1)
     return masters
 
 
@@ -164,9 +180,12 @@ def test_restarted_master_catches_up(tmp_path):
         victim.stop()
         rpc.call(multi_addr([m for m in masters if m is not victim]),
                  "POST", "/dbs/while_down")
+        # the victim's dirs live under whichever attempt dir its group
+        # bootstrapped in — recover them from its own store path
+        vdir = victim.store._persist_path.rsplit("/", 1)[0]
         m2 = MasterServer(
-            persist_path=str(tmp_path / f"m{vid}" / "meta.json"),
-            meta_dir=str(tmp_path / f"m{vid}"),
+            persist_path=f"{vdir}/meta.json",
+            meta_dir=vdir,
             node_id=vid, peers=dict(victim.peers),
             election_timeout=0.6, heartbeat_ttl=2.0,
         )
