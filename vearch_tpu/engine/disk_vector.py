@@ -1,0 +1,184 @@
+"""Disk-resident raw vector store (mmap-backed).
+
+TPU-native analogue of the reference's beyond-RAM vector storage
+(reference: internal/engine/vector/rocksdb_raw_vector.cc — RocksDB-backed
+RawVector — and the DiskANN static tier,
+index/impl/diskann/gamma_index_diskann_static.cc:28, whose raw data lives
+on disk and only compressed codes stay in RAM).
+
+Instead of a KV store, rows live docid-ordered in one flat mmap'd file:
+- append = write through the mapping (the OS page cache absorbs it);
+- growth = ftruncate + remap, no copy (the file IS the buffer);
+- reads (rerank gathers, training samples) fault pages on demand, so
+  host RSS stays bounded by the page cache, not the dataset;
+- `flush_disk()` msyncs and records the durable row count in meta.json;
+  rows past that count are garbage after a crash and are re-written by
+  WAL replay (same discipline as the npy-dump stores).
+
+The full-precision file is the rerank/training tier; the scan tier is
+the DISKANN index's int8 mmap + HBM bucket cache (index/disk.py). A
+`device_buffer()` call on this store intentionally raises: mirroring a
+beyond-RAM store into HBM is always a bug upstream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from vearch_tpu.engine.raw_vector import RawVectorStore
+
+
+class DiskRawVectorStore(RawVectorStore):
+    durable_on_disk = True
+
+    def __init__(
+        self,
+        dimension: int,
+        directory: str,
+        init_capacity: int = 4096,
+        store_dtype: str = "float32",
+    ):
+        # note: base __init__ is NOT called — the host buffer is a memmap
+        self.dimension = dimension
+        if store_dtype == "bfloat16":
+            # halves disk footprint + page-cache pressure; ml_dtypes
+            # registers bfloat16 as a real numpy dtype so the memmap
+            # reads/writes it natively (backup npy dumps widen to f32)
+            import ml_dtypes
+
+            self.store_dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            self.store_dtype = np.dtype(store_dtype)
+        self._itemsize = self.store_dtype.itemsize
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._raw_path = os.path.join(directory, "raw.f32")
+        self._meta_path = os.path.join(directory, "meta.json")
+        self._n = 0
+        durable_cap = init_capacity
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            assert meta["dimension"] == dimension, (
+                f"disk store at {directory} has dimension "
+                f"{meta['dimension']}, schema says {dimension}"
+            )
+            assert meta.get("dtype", "float32") == self.store_dtype.name, (
+                f"disk store at {directory} was written as "
+                f"{meta.get('dtype')}, schema says {self.store_dtype.name}"
+            )
+            self._n = int(meta["n"])
+            durable_cap = max(durable_cap, self._n)
+        self._host = self._map(max(durable_cap, 1))
+        # device mirror fields kept for interface parity (never populated)
+        self._device = None
+        self._device_sqnorm = None
+        self._device_rows = 0
+        self._sh_cache = None
+        self._sh_sqnorm = None
+
+    def _map(self, capacity: int) -> np.memmap:
+        rowbytes = self.dimension * self._itemsize
+        want = capacity * rowbytes
+        have = (
+            os.path.getsize(self._raw_path)
+            if os.path.exists(self._raw_path)
+            else 0
+        )
+        if have < want:
+            with open(self._raw_path, "ab") as f:
+                f.truncate(want)
+        cap = max(want, have) // rowbytes
+        return np.memmap(
+            self._raw_path, dtype=self.store_dtype, mode="r+",
+            shape=(cap, self.dimension),
+        )
+
+    def add(self, vectors: np.ndarray) -> int:
+        b = vectors.shape[0]
+        assert vectors.shape[1] == self.dimension
+        if self._n + b > self._host.shape[0]:
+            new_cap = max(self._host.shape[0] * 2, self._n + b, 1024)
+            self._host.flush()
+            self._host = self._map(new_cap)
+        start = self._n
+        self._host[start : start + b] = vectors
+        self._n += b
+        return start
+
+    def get_rows(self, docids: np.ndarray) -> np.ndarray:
+        """Gather [len(docids), d] f32 rows (rerank path — pages fault in
+        from disk on demand; hot rows ride the OS page cache)."""
+        return np.asarray(self._host[np.asarray(docids, dtype=np.int64)])
+
+    def device_buffer(self):
+        raise RuntimeError(
+            "DiskRawVectorStore cannot be mirrored into HBM; use a "
+            "disk-aware index type (DISKANN) for this field"
+        )
+
+    def device_buffer_sharded(self, mesh):
+        raise RuntimeError(
+            "DiskRawVectorStore cannot be mirrored into HBM; use a "
+            "disk-aware index type (DISKANN) for this field"
+        )
+
+    def flush_disk(self, n: int | None = None) -> None:
+        """msync + record the durable row count (the dump barrier).
+
+        `n` pins the recorded count to a snapshot-consistent value: a
+        concurrent upsert between snapshot capture and flush must not
+        advance the durable count past the table dump it pairs with
+        (rows beyond it are garbage until WAL replay rewrites them).
+        """
+        self._host.flush()
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"n": self._n if n is None else int(n),
+                 "dimension": self.dimension,
+                 "dtype": self.store_dtype.name},
+                f,
+            )
+        os.replace(tmp, self._meta_path)
+
+    def memory_usage_bytes(self) -> int:
+        return 0  # rows live in the page cache, not anonymous memory
+
+    # -- persistence ---------------------------------------------------------
+
+    def dump(self, path: str) -> None:
+        # called only for dumps to a foreign directory (backup staging);
+        # the normal dump path flushes in place via flush_disk(). Widen
+        # non-standard dtypes (bfloat16) so the npy stays pickle-free.
+        view = np.asarray(self.host_view())
+        if view.dtype.kind not in "fiu":
+            view = view.astype(np.float32)
+        np.save(path, view)
+
+    def load(self, path: str) -> None:
+        """Restore path. With an npy present (foreign-dir backup), copy
+        its contents into the mmap; without one (in-place dump), roll
+        the live count back to the durable barrier in meta.json so a
+        live-engine load() is symmetric with RAM-backed stores (table
+        and store counts must revert together — docid == row id)."""
+        if not os.path.exists(path):
+            if os.path.exists(self._meta_path):
+                with open(self._meta_path) as f:
+                    self._n = int(json.load(f)["n"])
+            return
+        if os.path.exists(path):
+            data = np.load(path, mmap_mode="r")
+            self._n = 0
+            if self._host.shape[0] < data.shape[0]:
+                self._host = self._map(data.shape[0])
+            # stream in chunks: the source may exceed RAM
+            step = max(1, (64 << 20) // (self.dimension * 4))
+            for lo in range(0, data.shape[0], step):
+                hi = min(lo + step, data.shape[0])
+                self._host[lo:hi] = data[lo:hi]
+            self._n = data.shape[0]
+            self.flush_disk()

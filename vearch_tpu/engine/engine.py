@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import threading
 import uuid
 from dataclasses import dataclass, field
@@ -109,7 +110,23 @@ class Engine:
         for f in schema.vector_fields():
             params = f.index or IndexParams()
             dtype = params.get("store_dtype", "float32")
-            store = RawVectorStore(f.dimension, store_dtype=dtype)
+            store_type = str(params.get("store_type", "MemoryOnly"))
+            disk_index = params.index_type.upper() in (
+                "DISKANN", "DISKANN_STATIC"
+            )
+            if store_type in ("Disk", "RocksDB") or disk_index:
+                # disk tier (reference: RocksDBRawVector + DiskANN static
+                # raw data): rows live in an mmap, not host RAM
+                from vearch_tpu.engine.disk_vector import DiskRawVectorStore
+
+                base = data_dir or tempfile.mkdtemp(prefix="vearch_disk_")
+                store: RawVectorStore = DiskRawVectorStore(
+                    f.dimension,
+                    directory=os.path.join(base, f"disk_{f.name}"),
+                    store_dtype=dtype,
+                )
+            else:
+                store = RawVectorStore(f.dimension, store_dtype=dtype)
             self.vector_stores[f.name] = store
             self.indexes[f.name] = create_index(params, store)
 
@@ -190,7 +207,10 @@ class Engine:
         memory/memoryManager.cc accounting)."""
         total = 0
         for store in self.vector_stores.values():
-            total += store.host_view().nbytes  # used rows, not capacity
+            if getattr(store, "durable_on_disk", False):
+                total += store.memory_usage_bytes()  # page cache, not RSS
+            else:
+                total += store.host_view().nbytes  # used rows, not capacity
         for index in self.indexes.values():
             mirror = getattr(index, "_mirror", None)
             if mirror is not None:
@@ -572,8 +592,26 @@ class Engine:
             json.dump(self.schema.to_dict(), f)
         self.table.dump_snapshot(snap["table"], os.path.join(dirpath, "table"))
         np.save(os.path.join(dirpath, "bitmap.npy"), snap["bits"])
+        in_place = bool(
+            self.data_dir
+            and os.path.commonpath(
+                [os.path.abspath(dirpath), os.path.abspath(self.data_dir)]
+            ) == os.path.abspath(self.data_dir)
+        )
         for name, view in snap["vecs"].items():
-            np.save(os.path.join(dirpath, f"vectors_{name}.npy"), view)
+            store = self.vector_stores[name]
+            if getattr(store, "durable_on_disk", False) and in_place:
+                # disk store dumping into its own data_dir: the mmap IS
+                # the payload — msync + record the durable count instead
+                # of copying a beyond-RAM file into an npy
+                store.flush_disk(n=view.shape[0])
+            else:
+                arr = np.asarray(view)
+                if arr.dtype.kind not in "fiu":
+                    # ml_dtypes (bfloat16) need pickle to round-trip npy;
+                    # widen to f32 so backups stay allow_pickle=False
+                    arr = arr.astype(np.float32)
+                np.save(os.path.join(dirpath, f"vectors_{name}.npy"), arr)
         for name, index in self.indexes.items():
             state = index.dump_state()
             if state:

@@ -2,6 +2,7 @@
 reference: the static REGISTER_INDEX initialisers in index/impl/*.cc)."""
 
 import vearch_tpu.index.binary  # noqa: F401
+import vearch_tpu.index.disk  # noqa: F401
 import vearch_tpu.index.flat  # noqa: F401
 import vearch_tpu.index.hnsw  # noqa: F401
 import vearch_tpu.index.ivf  # noqa: F401

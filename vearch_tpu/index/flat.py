@@ -34,6 +34,15 @@ class FlatIndex(VectorIndex):
         valid_mask: np.ndarray | None,
         params: dict | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
+        from vearch_tpu.index._store_paths import disk_brute_force, is_disk_store
+
+        if is_disk_store(self.store):
+            # beyond-RAM store: stream the mmap through the device in
+            # fixed-shape chunks instead of mirroring it into HBM
+            return disk_brute_force(
+                self.store, np.asarray(queries, np.float32), k,
+                valid_mask, self.metric,
+            )
         base, base_sqnorm, n = self.store.device_buffer()
         cap = base.shape[0]
         mask = to_device_mask(valid_mask, n, cap)

@@ -82,10 +82,10 @@ class HNSWIndex(VectorIndex):
             jnp.asarray(q), a8, scale, vsq, valid,
             min(ef, max(self.indexed_count, 1)), metric,
         )
-        base, base_sqnorm, _ = self.store.device_buffer()
-        scores, ids = ivf_ops.exact_rerank(
-            jnp.asarray(q, dtype=base.dtype), cand_i, base, base_sqnorm,
-            min(k, int(cand_i.shape[1])), self.metric,
+        from vearch_tpu.index._store_paths import rerank_against_store
+
+        scores, ids = rerank_against_store(
+            self.store, q, cand_i, k, self.metric,
         )
         scores, ids = jax.device_get((scores, ids))
         if scores.shape[1] < k:

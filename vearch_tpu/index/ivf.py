@@ -428,7 +428,15 @@ class IVFPQIndex(_IVFBase):
             if self.data_parallel:
                 limit *= max(len(jax.devices()), 1)
             mode = "full" if self.indexed_count <= limit else "probe"
-        if mode == "full" and self.data_parallel:
+        from vearch_tpu.index._store_paths import is_disk_store
+
+        if (
+            mode == "full" and self.data_parallel
+            and not is_disk_store(self.store)
+        ):
+            # mesh mode needs the raw buffer sharded across HBM — a
+            # disk store can't provide that; fall through to the
+            # single-device scan with host-gathered rerank
             return self._search_mesh(q, k, valid_mask, params, metric)
         if mode == "full":
             approx8, scale, vsq = self._mirror.flush()
@@ -486,14 +494,10 @@ class IVFPQIndex(_IVFBase):
                     max(r, k),
                     metric,
                 )
-        base, base_sqnorm, _ = self.store.device_buffer()
-        scores, ids = ivf_ops.exact_rerank(
-            jnp.asarray(q, dtype=base.dtype),
-            cand_i,
-            base,
-            base_sqnorm,
-            min(k, int(cand_i.shape[1])),
-            self.metric,
+        from vearch_tpu.index._store_paths import rerank_against_store
+
+        scores, ids = rerank_against_store(
+            self.store, q, cand_i, min(k, int(cand_i.shape[1])), self.metric,
         )
         scores, ids = jax.device_get((scores, ids))
         return self._pad_to_k(scores, ids, k)

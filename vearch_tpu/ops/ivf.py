@@ -204,12 +204,21 @@ def int8_scan_candidates(
     lax.top_k over [B, 1M] f32 is a giant multi-pass sort (measured
     482ms of a 511ms scan at B=1024 on v5e — 94% of the kernel). Stage
     1 reduces each 512-wide block to its max (single pass over bf16
-    scores) and picks the top r//4 blocks per query; stage 2 sorts only
-    the gathered blocks (r//4 * 512 elements). Measured: 96ms vs 482ms
-    at [1024, 1M], 5x. Candidates are approximate in the same sense as
-    ADC itself (a doc shadowed by >nb stronger block-maxes can drop
-    out); the exact rerank stage restores ordering, and the bench recall
-    gate measures the net effect (0.98 recall@10 at r=128, unchanged).
+    scores) and picks candidate blocks per query; stage 2 sorts only
+    the gathered blocks. Measured: 96ms vs 482ms at [1024, 1M], 5x.
+    Candidates are approximate in the same sense as ADC itself (a doc
+    shadowed by stronger block-maxes can drop out); the exact rerank
+    stage restores ordering.
+
+    PRECISION (r2 bench regression, recall 0.98 -> 0.70 on v5e): L2
+    scores at SIFT-like magnitudes are ~1e3 with neighbor gaps of a few
+    units; bf16's 8-bit mantissa rounds them to ±4, which is fine for
+    *choosing blocks* but catastrophic for ranking candidates (XLA CPU
+    constant-folds the bf16 round-trip away, so the loss only shows on
+    real TPU). Stage 1 therefore stays bf16 (bandwidth-bound pass over
+    the whole matrix) but over-selects 2x+8 blocks as rounding margin,
+    and stage 2 gathers the chosen blocks from the f32 score matrix so
+    final candidate ranking is exact.
 
     NOTE(perf): a chunked (scan-over-blocks) top-k was tried in r1 and
     measured WORSE (543ms -> 1227ms): many small matmul steps are
@@ -241,12 +250,16 @@ def int8_scan_candidates(
     if not use_block:
         top_s, ids = jax.lax.top_k(scores, r)
     else:
-        nb = min(nb, nblk)
-        s3 = scores.astype(jnp.bfloat16).reshape(b, nblk, BLOCK)
-        bmax = jnp.max(s3, axis=2).astype(jnp.float32)  # [B, nblk]
+        # 2x + 8 over-selection absorbs bf16 rounding of the block maxima
+        nb = min(2 * nb + 8, nblk)
+        s3f = scores.reshape(b, nblk, BLOCK)
+        bmax = jnp.max(
+            s3f.astype(jnp.bfloat16), axis=2
+        ).astype(jnp.float32)  # [B, nblk]
         _, top_blocks = jax.lax.top_k(bmax, nb)  # [B, nb]
-        gathered = jnp.take_along_axis(s3, top_blocks[:, :, None], axis=1)
-        flat = gathered.reshape(b, nb * BLOCK).astype(jnp.float32)
+        # gather the chosen blocks at FULL precision for the final rank
+        gathered = jnp.take_along_axis(s3f, top_blocks[:, :, None], axis=1)
+        flat = gathered.reshape(b, nb * BLOCK)
         top_s, pos = jax.lax.top_k(flat, min(r, nb * BLOCK))
         ids = top_blocks[jnp.arange(b)[:, None], pos // BLOCK] * BLOCK \
             + pos % BLOCK
@@ -256,6 +269,92 @@ def int8_scan_candidates(
     # resurrect them with genuine similarity scores (bf16 stage scores
     # are selection-only; the rerank stage recomputes exact scores)
     return top_s, jnp.where(jnp.isfinite(top_s), ids, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("r", "metric"))
+def cached_bucket_scan(
+    queries: jax.Array,     # [B, d] f32
+    pool8: jax.Array,       # [slots, cap, d] int8 (HBM bucket cache)
+    pool_scale: jax.Array,  # [slots, cap] f32 per-row dequant scale
+    pool_vsq: jax.Array,    # [slots, cap] f32 ||approx||^2
+    pool_ids: jax.Array,    # [slots, cap] i32 docids (-1 padding)
+    probe_slots: jax.Array,  # [B, nprobe] i32 cache slot per probe
+    valid: jax.Array,       # [n_pad] bool (docid-indexed)
+    r: int,
+    metric: MetricType = MetricType.L2,
+) -> tuple[jax.Array, jax.Array]:
+    """Probe scan over the HBM bucket cache (disk-tier search path).
+
+    Identical math to `int8_scan_candidates` restricted to the probed
+    slabs: rows are per-row-scaled int8 approximations of FULL vectors
+    (not residuals), so score = f(q . row) with no centroid term. The
+    slot indirection was resolved on host by HbmBucketCache; the kernel
+    only ever sees static shapes [slots, cap, d], so one compile serves
+    the whole life of a cache generation.
+    """
+    b = queries.shape[0]
+    nprobe = probe_slots.shape[1]
+    q_sq = sqnorms(queries)
+    qb = queries.astype(jnp.bfloat16)
+
+    init = (
+        jnp.full((b, r), NEG_INF, jnp.float32),
+        jnp.full((b, r), -1, jnp.int32),
+    )
+
+    def step(best, pr):
+        s = probe_slots[:, pr]  # [B]
+        slab8 = pool8[s]  # [B, cap, d]
+        ids = pool_ids[s]  # [B, cap]
+        vsq = pool_vsq[s]
+        dot8 = jax.lax.dot_general(
+            qb, slab8.astype(jnp.bfloat16), (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [B, cap]
+        dots = pool_scale[s] * dot8
+        if metric is MetricType.L2:
+            scores = -(q_sq[:, None] - 2.0 * dots + vsq)
+        else:
+            scores = dots
+        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)]
+        scores = jnp.where(ok, scores, NEG_INF)
+        return _fold_topk(best, scores, ids), None
+
+    (best_s, best_i), _ = jax.lax.scan(step, init, jnp.arange(nprobe))
+    return best_s, jnp.where(jnp.isfinite(best_s), best_i, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def exact_rerank_gathered(
+    queries: jax.Array,    # [B, d] f32
+    cand_ids: jax.Array,   # [B, r] i32 (-1 padding)
+    cand_vecs: jax.Array,  # [B, r, d] f32 (host-gathered raw rows)
+    k: int,
+    metric: MetricType = MetricType.L2,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact rerank when the raw base lives on disk: candidate rows were
+    gathered host-side (mmap page faults) and ride up as one [B, r, d]
+    blob — the only H2D traffic the disk tier pays per query batch."""
+    dots = jax.lax.dot_general(
+        queries, cand_vecs, (((1,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=dot_precision(queries, cand_vecs),
+    )  # [B, r]
+    vsq = jnp.sum(
+        cand_vecs.astype(jnp.float32) ** 2, axis=2
+    )
+    if metric is MetricType.L2:
+        scores = -(sqnorms(queries)[:, None] - 2.0 * dots + vsq)
+    elif metric is MetricType.COSINE:
+        qn = jnp.sqrt(jnp.maximum(sqnorms(queries), 1e-30))[:, None]
+        vn = jnp.sqrt(jnp.maximum(vsq, 1e-30))
+        scores = dots / (qn * vn)
+    else:
+        scores = dots
+    scores = jnp.where(cand_ids >= 0, scores, NEG_INF)
+    k = min(k, scores.shape[1])
+    top_s, pos = jax.lax.top_k(scores, k)
+    return top_s, jnp.take_along_axis(cand_ids, pos, axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
