@@ -1,25 +1,28 @@
-"""HNSW index type — TPU-native interpretation.
+"""HNSW index type — TPU-native interpretation with a real graph tier.
 
 The reference vendors hnswlib (reference: index/impl/hnswlib/
-gamma_index_hnswlib.cc:130) because pointer-chasing graph walks are the
-right sublinear structure for CPUs. On TPU the same query budget buys a
-dense MXU scan: at any N that fits a chip, one int8 matmul beats a graph
-walk (hundreds of *dependent* gathers serialised through the VPU). So the
-HNSW *index type* is kept for API parity — spaces declaring
-`index_type: "HNSW"` work, `efSearch`/`efConstruction` are accepted — and
-maps onto a two-stage device scan:
+gamma_index_hnswlib.cc:130). Two serving modes live behind the one
+index type (param `graph`, default "auto"):
 
-    stage 1: int8-quantized scan of all rows (the coarse pass)
-    stage 2: exact rerank of the top `efSearch` candidates
+- **scan** (TPU default): pointer-chasing graph walks are wrong for the
+  MXU; at any N that fits a chip, a two-stage device scan (int8 coarse
+  pass + exact rerank of the top `efSearch`) beats a graph walk while
+  preserving HNSW's contract (approximate, efSearch recall knob,
+  realtime inserts, deletes honored).
+- **graph**: an actual host-side HNSW graph (csrc/vearch_hnsw.cpp — an
+  independent implementation of Malkov & Yashunin 2016, not vendored
+  hnswlib), for the regimes a scan can't serve: beyond-HBM row counts
+  (pairs with DiskRawVectorStore: the graph owns its own host copy) and
+  single-query low-latency paths with no device round-trip.
 
-This preserves HNSW's contract (approximate; efSearch = recall knob;
-realtime inserts; deletes honored) with strictly better recall at the
-same latency on this hardware; BASELINE.md's HNSW row ("brute-force
-rerank on TPU") sanctions exactly this design. A host-side graph build
-remains the escape hatch for beyond-HBM regimes (docs/ROADMAP).
+"auto" = graph when the raw store is disk-resident and the native
+toolchain is present, else scan. `graph: true` forces the graph (errors
+without a toolchain); `graph: false` forces the scan.
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +44,28 @@ class HNSWIndex(VectorIndex):
     def __init__(self, params: IndexParams, store: RawVectorStore):
         super().__init__(params, store)
         self.ef_search = int(params.get("efSearch", params.get("ef_search", 64)))
+        self.m = int(params.get("nlinks", params.get("M", 16)))
+        self.ef_construction = int(
+            params.get("efConstruction", params.get("ef_construction", 200))
+        )
         self._mirror = Int8Mirror(store.dimension)
+        self._graph = None
+        mode = params.get("graph", "auto")
+        if mode == "auto":
+            from vearch_tpu.index._store_paths import is_disk_store
+            from vearch_tpu.native import hnsw_graph
+
+            self.use_graph = is_disk_store(store) and hnsw_graph.available()
+        else:
+            self.use_graph = bool(mode)
+        if self.use_graph:
+            from vearch_tpu.native.hnsw_graph import HnswGraph
+
+            self._graph = HnswGraph(
+                store.dimension, m=self.m,
+                ef_construction=self.ef_construction,
+                ip=self.metric is not MetricType.L2,
+            )
 
     def _maybe_normalize(self, x: np.ndarray) -> np.ndarray:
         if self.metric is MetricType.COSINE:
@@ -55,9 +79,13 @@ class HNSWIndex(VectorIndex):
                 return
             start = self.indexed_count
             rows = self._maybe_normalize(
-                self.store.host_view()[start:upto].astype(np.float32)
+                np.asarray(self.store.host_view()[start:upto],
+                           dtype=np.float32)
             )
-            self._mirror.append(rows, start=start)
+            if self._graph is not None:
+                self._graph.add(rows)
+            else:
+                self._mirror.append(rows, start=start)
             self.indexed_count = upto
 
     def search(
@@ -68,10 +96,36 @@ class HNSWIndex(VectorIndex):
         params: dict | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         self.absorb(self.store.count)
-        a8, scale, vsq = self._mirror.flush()
         p = params or {}
         ef = max(int(p.get("efSearch", p.get("ef_search", self.ef_search))), k)
         q = self._maybe_normalize(np.asarray(queries, np.float32))
+        if self._graph is not None:
+            return self._search_graph(q, k, ef, valid_mask)
+        return self._search_scan(q, k, ef, valid_mask)
+
+    def _search_graph(
+        self, q: np.ndarray, k: int, ef: int, valid_mask
+    ) -> tuple[np.ndarray, np.ndarray]:
+        mask = None
+        n = self._graph.count
+        if valid_mask is not None:
+            mask = np.asarray(valid_mask, dtype=np.uint8)
+            if mask.shape[0] < n:
+                mask = np.pad(mask, (0, n - mask.shape[0]))
+        elif n > self.indexed_count:
+            # a crash-rollback load can leave phantom graph nodes past
+            # the durable count; mask them out rather than serving them
+            mask = np.zeros(n, dtype=np.uint8)
+            mask[: self.indexed_count] = 1
+        scores, ids = self._graph.search(q, k, ef, mask)
+        # graph distances are exact f32 (the graph owns full-precision
+        # rows), so scores are final: -L2^2, or dot on normalized rows
+        return scores, ids.astype(np.int64)
+
+    def _search_scan(
+        self, q: np.ndarray, k: int, ef: int, valid_mask
+    ) -> tuple[np.ndarray, np.ndarray]:
+        a8, scale, vsq = self._mirror.flush()
         metric = (
             MetricType.INNER_PRODUCT
             if self.metric is MetricType.COSINE
@@ -94,3 +148,68 @@ class HNSWIndex(VectorIndex):
                             constant_values=float("-inf"))
             ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
         return scores[:, :k], ids[:, :k]
+
+    # -- persistence ---------------------------------------------------------
+
+    def dump_state(self) -> dict[str, Any]:
+        if self._graph is None or self._graph.count == 0:
+            return {}
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=".hnsw")
+        os.close(fd)
+        try:
+            self._graph.save(tmp)
+            with open(tmp, "rb") as f:
+                blob = np.frombuffer(f.read(), dtype=np.uint8)
+        finally:
+            os.unlink(tmp)
+        return {
+            "graph_blob": blob,
+            "indexed_count": np.int64(self.indexed_count),
+        }
+
+    def load_state(self, state: dict[str, Any]) -> None:
+        if "graph_blob" not in state or self._graph is None:
+            # scan mode re-absorbs from raw vectors on demand
+            return
+        import os
+        import tempfile
+
+        from vearch_tpu.native.hnsw_graph import HnswGraph
+
+        fd, tmp = tempfile.mkstemp(suffix=".hnsw")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(np.asarray(state["graph_blob"]).tobytes())
+            self._graph = HnswGraph.load(
+                tmp, self.store.dimension, m=self.m,
+                ef_construction=self.ef_construction,
+                ip=self.metric is not MetricType.L2,
+            )
+        except ValueError:
+            # corrupt blob: raw vectors are the durable source of truth
+            # — fall through to the rebuild path below
+            self._graph = HnswGraph(
+                self.store.dimension, m=self.m,
+                ef_construction=self.ef_construction,
+                ip=self.metric is not MetricType.L2,
+            )
+        finally:
+            os.unlink(tmp)
+        saved = int(state.get("indexed_count", self._graph.count))
+        if saved != self._graph.count or saved > self.store.count:
+            # graph ids must stay == docids; any snapshot/store mismatch
+            # (crash rollback) means appends would misalign — rebuild
+            self._graph = HnswGraph(
+                self.store.dimension, m=self.m,
+                ef_construction=self.ef_construction,
+                ip=self.metric is not MetricType.L2,
+            )
+            self.indexed_count = 0
+        else:
+            self.indexed_count = saved
+        # tail rows past the snapshot re-absorb from the raw store
+        self.absorb(self.store.count)

@@ -1,0 +1,160 @@
+"""Python wrapper for the native HNSW graph (csrc/vearch_hnsw.cpp).
+
+Same compile-on-demand + source-hash staleness discipline as the main
+native module. No numpy fallback here — when the toolchain is missing,
+`HnswGraph.available()` is False and index/hnsw.py stays on its device
+scan path (which is also the default; the graph serves the beyond-HBM /
+single-query regime).
+
+Thread model: one writer (the engine's absorb lock), readers serialized
+by the GIL at the call boundary; the C++ side releases the GIL inside
+add/search, so `_rw` (a plain mutex) makes add and search mutually
+exclusive — the graph's link arrays are not safe to read mid-insert.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+    "vearch_hnsw.cpp",
+)
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "vearch_hnsw.so")
+_HASH = _SO + ".srchash"
+
+
+def _load():
+    global _mod, _tried
+    with _lock:
+        if _mod is not None or _tried:
+            return _mod
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        with open(_SRC, "rb") as f:
+            h = hashlib.sha256(f.read()).hexdigest()
+        stale = True
+        if os.path.exists(_SO) and os.path.exists(_HASH):
+            with open(_HASH) as f:
+                stale = f.read().strip() != h
+        if stale:
+            include = sysconfig.get_paths()["include"]
+            cmd = [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                f"-I{include}", _SRC, "-o", _SO,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=180)
+                with open(_HASH, "w") as f:
+                    f.write(h)
+            except Exception:
+                return None
+        try:
+            spec = importlib.util.spec_from_file_location("vearch_hnsw", _SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _mod = mod
+        except Exception:
+            _mod = None
+        return _mod
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class HnswGraph:
+    """Owning handle over one native HNSW graph."""
+
+    def __init__(self, dim: int, m: int = 16, ef_construction: int = 200,
+                 ip: bool = False, seed: int = 0x5EED):
+        mod = _load()
+        if mod is None:
+            raise RuntimeError(
+                "native HNSW unavailable (no toolchain); use the device "
+                "scan path instead"
+            )
+        self._mod = mod
+        self.dim = dim
+        self.m = m
+        self.ef_construction = ef_construction
+        self.ip = ip
+        self._h = mod.hnsw_new(dim, m, ef_construction, 1 if ip else 0, seed)
+        self._rw = threading.Lock()
+
+    @property
+    def count(self) -> int:
+        return int(self._mod.hnsw_count(self._h))
+
+    def add(self, rows: np.ndarray) -> int:
+        # ndarrays satisfy the y* buffer protocol directly — no tobytes
+        # copy (the graph's target regime is beyond-HBM batches)
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        with self._rw:
+            return int(self._mod.hnsw_add(self._h, rows, rows.shape[0]))
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        ef: int,
+        valid_mask: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (scores [B, k] similarity-oriented, ids [B, k] i64;
+        -inf/-1 padding). `valid_mask` is a bool array over docids."""
+        q = np.ascontiguousarray(queries, dtype=np.float32)
+        b = q.shape[0]
+        v = None
+        if valid_mask is not None:
+            v = np.ascontiguousarray(valid_mask, dtype=np.uint8)
+        with self._rw:
+            if v is not None and v.shape[0] < (n := self.count):
+                # the graph may have grown since the caller sized the
+                # mask (concurrent absorb); newer nodes are invalid for
+                # this request — pad under the lock so len >= n holds
+                v = np.pad(v, (0, n - v.shape[0]))
+            out_s, out_i = self._mod.hnsw_search(self._h, q, b, k, ef, v)
+        return (
+            np.frombuffer(out_s, dtype=np.float32).reshape(b, k).copy(),
+            np.frombuffer(out_i, dtype=np.int64).reshape(b, k).copy(),
+        )
+
+    def save(self, path: str) -> None:
+        with self._rw:
+            self._mod.hnsw_save(self._h, path)
+
+    @classmethod
+    def load(cls, path: str, dim: int, m: int = 16,
+             ef_construction: int = 200, ip: bool = False) -> "HnswGraph":
+        mod = _load()
+        if mod is None:
+            raise RuntimeError("native HNSW unavailable")
+        g = cls.__new__(cls)
+        g._mod = mod
+        g.dim = dim
+        g.m = m
+        g.ef_construction = ef_construction
+        g.ip = ip
+        g._h = mod.hnsw_load(dim, m, ef_construction, 1 if ip else 0, path)
+        g._rw = threading.Lock()
+        return g
+
+    def __del__(self):
+        try:
+            self._mod.hnsw_free(self._h)
+        except Exception:
+            pass
