@@ -348,6 +348,11 @@ PyObject* py_hnsw_search(PyObject*, PyObject* args) {
     PyBuffer_Release(&qbuf);
     return nullptr;
   }
+  if (qbuf.len < static_cast<Py_ssize_t>(B * g->dim * sizeof(float))) {
+    PyBuffer_Release(&qbuf);
+    PyErr_SetString(PyExc_ValueError, "query buffer too small for B*dim");
+    return nullptr;
+  }
   Py_buffer vbuf;
   const uint8_t* valid = nullptr;
   bool have_v = false;
@@ -483,6 +488,9 @@ PyObject* py_hnsw_load(PyObject*, PyObject* args) {
              static_cast<size_t>(n) * dim;
     for (int64_t i = 0; ok && i < n; i++)
       ok = g->levels[i] >= 0 && g->levels[i] <= g->max_level;
+    // the greedy descent starts at entry and indexes links[entry][l]
+    // for every l up to max_level — entry must actually live there
+    if (ok && n > 0) ok = g->levels[g->entry] == g->max_level;
   }
   if (ok) {
     g->links.resize(n);

@@ -137,3 +137,70 @@ def test_request_kill_switch(tmp_path, rng):
     finally:
         ps.stop()
         master.stop()
+
+
+def test_debug_heap_endpoint():
+    m = MasterServer()
+    m.start()
+    try:
+        with urllib.request.urlopen(f"http://{m.addr}/debug/heap") as r:
+            assert b"tracemalloc started" in r.read()
+        with urllib.request.urlopen(f"http://{m.addr}/debug/heap") as r:
+            text = r.read().decode()
+        assert "heap:" in text and "KiB" in text
+        with urllib.request.urlopen(
+            f"http://{m.addr}/debug/heap?stop=1"
+        ) as r:
+            assert b"stopped" in r.read()
+    finally:
+        m.stop()
+
+
+def test_slow_channel_routing(tmp_path, rng):
+    """Slow-query isolation (reference: dedicated slow-search channel
+    pool, ps/server.go:95): partitions with slow latency history route
+    through a separate small gate and are counted."""
+    import numpy as np
+
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+
+    master = MasterServer()
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=master.addr)
+    ps.start()
+    try:
+        rpc.call(ps.addr, "POST", "/ps/partition/create", {
+            "partition": {"id": 1, "space_id": 1, "db_name": "d",
+                          "space_name": "s", "slot": 0, "replicas": [],
+                          "leader": -1},
+            "schema": {"name": "s", "fields": [
+                {"name": "v", "data_type": "vector", "dimension": 16,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}}]},
+        })
+        vecs = rng.standard_normal((30, 16)).astype(np.float32)
+        rpc.call(ps.addr, "POST", "/ps/doc/upsert", {
+            "partition_id": 1,
+            "documents": [{"_id": f"d{i}", "v": vecs[i].tolist()}
+                          for i in range(30)]})
+        # prime the EWMA (first search includes compile time)
+        rpc.call(ps.addr, "POST", "/ps/doc/search",
+                 {"partition_id": 1, "vectors": {"v": vecs[:2]}, "k": 3})
+        stats = rpc.call(ps.addr, "GET", "/ps/stats")
+        assert "1" in stats["search_ewma_ms"]
+        assert stats["slow_routed"] == 0
+        # threshold below the observed history -> next search routes slow
+        rpc.call(ps.addr, "POST", "/ps/engine/config",
+                 {"partition_id": 1, "config": {"slow_route_ms": 1}})
+        # force a tiny positive EWMA regardless of timer resolution
+        ps._search_ewma[1] = max(ps._search_ewma.get(1, 0.0), 5.0)
+        out = rpc.call(ps.addr, "POST", "/ps/doc/search",
+                       {"partition_id": 1, "vectors": {"v": vecs[:2]},
+                        "k": 3})
+        assert out["results"]
+        assert rpc.call(ps.addr, "GET", "/ps/stats")["slow_routed"] >= 1
+    finally:
+        ps.stop()
+        master.stop()

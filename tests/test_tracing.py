@@ -1,0 +1,143 @@
+"""Distributed tracing: spans, cross-process propagation, /debug/traces.
+
+Reference: Jaeger end-to-end (cmd/vearch/startup.go:66 initJaeger;
+ps/handler_document.go:123 span-context extraction from rpcx metadata).
+Here: span trees propagated via the RPC envelope, stored per-process,
+queryable on every role."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.tracing import Tracer
+
+
+class TestTracer:
+    def test_span_tree_and_store(self):
+        tr = Tracer("svc")
+        with tr.span("root", tags={"a": 1}) as root:
+            with tr.span("child", ctx=root.ctx()) as child:
+                child.set_tag("b", 2)
+        spans = tr.spans()
+        assert len(spans) == 2
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+        assert by_name["child"]["trace_id"] == by_name["root"]["trace_id"]
+        assert by_name["root"]["tags"] == {"a": 1}
+        assert by_name["child"]["duration_us"] >= 0
+
+    def test_error_status(self):
+        tr = Tracer("svc")
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        assert tr.spans()[0]["status"].startswith("error")
+
+    def test_sampling(self):
+        tr = Tracer("svc", sample_rate=0.0)
+        assert not tr.should_sample(False)
+        assert tr.should_sample(True)  # explicit trace:true always wins
+        tr2 = Tracer("svc", sample_rate=1.0)
+        assert tr2.should_sample(False)
+
+    def test_filter_by_trace_id(self):
+        tr = Tracer("svc")
+        with tr.span("a") as sa:
+            pass
+        with tr.span("b"):
+            pass
+        only = tr.spans(trace_id=sa.trace_id)
+        assert len(only) == 1 and only[0]["name"] == "a"
+
+    def test_jsonl_export(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tr = Tracer("svc", export_path=path)
+        with tr.span("exported"):
+            pass
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[0]["name"] == "exported"
+        assert rows[0]["service"] == "svc"
+
+
+def _fetch_traces(addr: str, trace_id: str) -> list[dict]:
+    with urllib.request.urlopen(
+        f"http://{addr}/debug/traces?trace_id={trace_id}"
+    ) as r:
+        return json.loads(r.read())["spans"]
+
+
+def test_cluster_span_propagation(tmp_path, rng):
+    """trace:true search produces a linked span tree across router and
+    PS processes, queryable per role."""
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+    from vearch_tpu.sdk.client import VearchClient
+
+    master = MasterServer()
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "tr"), master_addr=master.addr)
+    ps.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("t")
+        cl.create_space("t", {
+            "name": "s", "partition_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": 16,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((40, 16)).astype(np.float32)
+        cl.upsert("t", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                             for i in range(40)])
+        import vearch_tpu.cluster.rpc as rpc
+
+        out = rpc.call(router.addr, "POST", "/document/search", {
+            "db_name": "t", "space_name": "s",
+            "vectors": [{"field": "v", "feature": vecs[3].tolist()}],
+            "limit": 3, "trace": True,
+        })
+        tid = out["trace_id"]
+        assert out["params"]  # timing breakdown still present
+
+        r_spans = _fetch_traces(router.addr, tid)
+        names = [s["name"] for s in r_spans]
+        assert "router.search" in names
+        assert names.count("router.scatter") == 2  # one per partition
+        root = next(s for s in r_spans if s["name"] == "router.search")
+        for s in r_spans:
+            if s["name"] == "router.scatter":
+                assert s["parent_id"] == root["span_id"]
+
+        p_spans = _fetch_traces(ps.addr, tid)
+        assert len(p_spans) == 2  # one ps.search per partition
+        scatter_ids = {s["span_id"] for s in r_spans
+                       if s["name"] == "router.scatter"}
+        for s in p_spans:
+            assert s["service"] == "ps"
+            assert s["trace_id"] == tid
+            # joined under the router's scatter spans... or directly the
+            # root (the scatter span wraps the rpc, so parent is root's
+            # child span id propagated in the envelope)
+            assert s["parent_id"] in scatter_ids or (
+                s["parent_id"] == root["span_id"]
+            )
+            # engine phase timings ride as tags
+            assert any(k.endswith("_ms") for k in s["tags"])
+
+        # untraced searches produce no new spans
+        before = len(_fetch_traces(router.addr, ""))
+        rpc.call(router.addr, "POST", "/document/search", {
+            "db_name": "t", "space_name": "s",
+            "vectors": [{"field": "v", "feature": vecs[3].tolist()}],
+            "limit": 3,
+        })
+        assert len(_fetch_traces(router.addr, "")) == before
+    finally:
+        router.stop()
+        ps.stop()
+        master.stop()

@@ -103,10 +103,18 @@ def main(argv: list[str] | None = None) -> int:
 
     from vearch_tpu.cluster.router import RouterServer
 
+    cfg_tr = {}
+    if args.conf:
+        from vearch_tpu.cluster.config import Config
+
+        cfg_tr = getattr(Config.load(args.conf), "tracer", {}) or {}
     server = RouterServer(
         master_addr=args.master_addr, host=args.host, port=args.port,
         auth=args.auth,
         master_auth=("root", args.root_password) if args.auth else None,
+        # reference: [tracer] config block (sampler rate), startup.go:66
+        trace_sample=float(cfg_tr.get("sample_rate", 0.0)),
+        trace_export=cfg_tr.get("export_path"),
     )
     server.start()
     print(f"router: http://{server.addr}", flush=True)

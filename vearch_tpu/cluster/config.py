@@ -36,6 +36,8 @@ class Config:
     master: dict[str, Any] = field(default_factory=dict)
     router: dict[str, Any] = field(default_factory=dict)
     ps: dict[str, Any] = field(default_factory=dict)
+    # reference: [tracer] block (sampler type/param), startup.go:66-85
+    tracer: dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str) -> "Config":
@@ -46,6 +48,7 @@ class Config:
             master=raw.get("master", {}),
             router=raw.get("router", {}),
             ps=raw.get("ps", {}),
+            tracer=raw.get("tracer", {}),
         )
         cfg.validate()
         return cfg
@@ -61,6 +64,9 @@ class Config:
         ttl = self.master.get("heartbeat_ttl")
         if ttl is not None and float(ttl) <= 0:
             raise ValueError("[master] heartbeat_ttl must be positive")
+        rate = self.tracer.get("sample_rate")
+        if rate is not None and not (0.0 <= float(rate) <= 1.0):
+            raise ValueError("[tracer] sample_rate must be in [0, 1]")
 
     @property
     def data_dir(self) -> str:

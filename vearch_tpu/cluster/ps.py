@@ -105,8 +105,28 @@ class PSServer:
         self._inflight_lock = threading.Lock()
         self.slow_request_ms = 0
         self.killed_requests = 0
+        # slow-query isolation (reference: dedicated slow-search channel
+        # pool, ps/server.go:95 + engine slow_search_time marking): each
+        # partition keeps an EWMA of its search latency; partitions
+        # whose history exceeds slow_route_ms are routed through a
+        # small separate semaphore so a hot/expensive space cannot
+        # occupy every fast-path slot. 0 disables routing.
+        self.slow_route_ms = 0
+        self._slow_gate = threading.BoundedSemaphore(
+            max(1, max_concurrent_searches // 4)
+        )
+        self._search_ewma: dict[int, float] = {}  # pid -> ms
+        self.slow_routed = 0
+
+        from vearch_tpu.cluster.tracing import Tracer
+
+        # spans join the router's trace via the _trace_ctx envelope
+        # (reference: PS extracts span context from rpcx metadata,
+        # ps/handler_document.go:123-126)
+        self.tracer = Tracer("ps")
 
         self.server = JsonRpcServer(host, port)
+        self.server.tracer = self.tracer
         s = self.server
         s.route("POST", "/ps/partition/create", self._h_create_partition)
         s.route("POST", "/ps/partition/delete", self._h_delete_partition)
@@ -662,22 +682,55 @@ class PSServer:
             name: np.asarray(v, dtype=np.float32)
             for name, v in body["vectors"].items()
         }
-        if not self._search_gate.acquire(timeout=30.0):
-            raise RpcError(429, "partition server search queue full")
+        pid = int(body["partition_id"])
+        # slow-channel routing: partitions with a slow recent history go
+        # through the small slow gate; everyone else uses the fast gate
+        slow = bool(
+            self.slow_route_ms
+            and self._search_ewma.get(pid, 0.0) > self.slow_route_ms
+        )
+        gate = self._slow_gate if slow else self._search_gate
+        if slow:
+            self.slow_routed += 1
+        if not gate.acquire(timeout=30.0):
+            raise RpcError(
+                429,
+                "partition server %s queue full"
+                % ("slow-search" if slow else "search"),
+            )
         rid = str(body.get("request_id") or uuid.uuid4().hex)
         token = uuid.uuid4().hex  # unique even when clients reuse rids
         ctx = RequestContext(rid)
+        t_start = time.time()
         with self._inflight_lock:
-            self._inflight[token] = {"rid": rid, "start": time.time(),
-                                     "ctx": ctx}
+            self._inflight[token] = {"rid": rid, "start": t_start,
+                                     "ctx": ctx, "slow": slow}
+        from vearch_tpu.cluster.tracing import NULL_SPAN
+
+        tctx = body.get("_trace_ctx")
+        span = (
+            self.tracer.span("ps.search", ctx=tctx,
+                             tags={"partition": pid, "node": self.node_id,
+                                   "slow_channel": slow})
+            if tctx else NULL_SPAN
+        )
         try:
-            return self._do_search(eng, body, vectors, ctx)
+            with span:
+                out = self._do_search(eng, body, vectors, ctx)
+                for phase, ms in (out.get("timing") or {}).items():
+                    span.set_tag(phase, ms)
+                return out
         except RequestKilled as e:
             raise RpcError(408, f"request {rid}: {e}") from e
         finally:
             with self._inflight_lock:
                 self._inflight.pop(token, None)
-            self._search_gate.release()
+            gate.release()
+            # EWMA update outside the lock: a lost update under a race
+            # only slows convergence
+            ms = (time.time() - t_start) * 1e3
+            prev = self._search_ewma.get(pid, ms)
+            self._search_ewma[pid] = 0.8 * prev + 0.2 * ms
 
     def _do_search(self, eng, body, vectors, ctx=None) -> dict:
         trace = {} if body.get("trace") else None
@@ -747,6 +800,9 @@ class PSServer:
         if "slow_request_ms" in cfg:
             # reference: slow_search_time runtime config -> slow killer
             self.slow_request_ms = int(cfg["slow_request_ms"])
+        if "slow_route_ms" in cfg:
+            # reference: slow-channel isolation threshold (ps/server.go:95)
+            self.slow_route_ms = int(cfg["slow_route_ms"])
         eng = self._engine(body["partition_id"])
         return eng.apply_config(cfg)
 
@@ -843,6 +899,12 @@ class PSServer:
             "node_id": self.node_id,
             "replication_errors": self.replication_errors,
             "killed_requests": self.killed_requests,
+            "slow_routed": self.slow_routed,
+            # snapshot first: search threads insert keys lock-free
+            "search_ewma_ms": {
+                str(pid): round(ms, 2)
+                for pid, ms in dict(self._search_ewma).items()
+            },
             "partitions": {
                 str(pid): {
                     "doc_count": eng.doc_count,

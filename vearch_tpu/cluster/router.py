@@ -32,8 +32,16 @@ class RouterServer:
         port: int = 0,
         auth: bool = False,
         master_auth: tuple[str, str] | None = None,
+        trace_sample: float = 0.0,
+        trace_export: str | None = None,
     ):
+        from vearch_tpu.cluster.tracing import Tracer
+
         self.master_addr = master_addr
+        # span tracer (reference: Jaeger init, startup.go:66; sampler
+        # rate from the [tracer] config block)
+        self.tracer = Tracer("router", sample_rate=trace_sample,
+                             export_path=trace_export)
         # service-account credentials for master calls when auth is on
         self.master_auth = master_auth
         self._space_cache: dict[str, tuple[float, Space]] = {}
@@ -63,6 +71,7 @@ class RouterServer:
         s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
         s.route("POST", "/partitions/rule", self._h_partition_rule)
         s.route("GET", "/cluster/health", self._h_health)
+        s.tracer = self.tracer  # serves GET /debug/traces
 
     def start(self) -> None:
         self.server.start()
@@ -375,32 +384,60 @@ class RouterServer:
 
         lb = body.get("load_balance", "leader")
 
-        def send(pid: int):
-            return self._call_partition(skey, pid, "/ps/doc/search", sub, lb)
+        from vearch_tpu.cluster.tracing import NULL_SPAN
 
-        import time as _time
+        explicit_trace = bool(body.get("trace", False))
+        root = (
+            self.tracer.span(
+                "router.search",
+                tags={"db": skey[0], "space": skey[1], "k": k,
+                      "batch": int(next(iter(vectors.values())).shape[0])},
+            )
+            if self.tracer.should_sample(explicit_trace)
+            else NULL_SPAN
+        )
+        with root:
+            if root.ctx() is not None:
+                sub["trace"] = True  # sampled spans imply phase timings
 
-        def timed(pid):
-            t0 = _time.time()
-            r = send(pid)
-            r["_rpc_ms"] = round((_time.time() - t0) * 1e3, 3)
-            return pid, r
+            import time as _time
 
-        futures = [
-            self._pool.submit(timed, p.id) for p in space.partitions
-        ]
-        results = [f.result() for f in futures]
-        partials = [r for _, r in results]
-        merged = self._merge_search(partials, k)
-        out = {"documents": merged}
-        if body.get("trace"):
-            # per-partition timing breakdown (reference: trace:true
-            # response params, client/client.go:521-565)
-            out["params"] = {
-                str(pid): {"rpc_ms": r["_rpc_ms"], **r.get("timing", {})}
-                for pid, r in results
-            }
-        return out
+            def timed(pid):
+                t0 = _time.time()
+                if root.ctx() is not None:
+                    span = self.tracer.span(
+                        "router.scatter", ctx=root.ctx(),
+                        tags={"partition": pid},
+                    )
+                    body_p = {**sub, "_trace_ctx": span.ctx()}
+                else:
+                    span, body_p = NULL_SPAN, sub
+                with span:
+                    r = self._call_partition(
+                        skey, pid, "/ps/doc/search", body_p, lb
+                    )
+                r["_rpc_ms"] = round((_time.time() - t0) * 1e3, 3)
+                return pid, r
+
+            futures = [
+                self._pool.submit(timed, p.id) for p in space.partitions
+            ]
+            results = [f.result() for f in futures]
+            partials = [r for _, r in results]
+            merged = self._merge_search(partials, k)
+            out = {"documents": merged}
+            if root.trace_id:
+                # lets clients pull the span tree from /debug/traces on
+                # each role (reference: Jaeger trace id in responses)
+                out["trace_id"] = root.trace_id
+            if body.get("trace"):
+                # per-partition timing breakdown (reference: trace:true
+                # response params, client/client.go:521-565)
+                out["params"] = {
+                    str(pid): {"rpc_ms": r["_rpc_ms"], **r.get("timing", {})}
+                    for pid, r in results
+                }
+            return out
 
     def _merge_search(
         self, partials: list[dict], k: int

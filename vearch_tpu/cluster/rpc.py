@@ -192,7 +192,9 @@ class JsonRpcServer:
                 plain_path = self.path.split("?")[0]
                 if method == "GET" and plain_path in ("/metrics",
                                                       "/debug/stacks",
-                                                      "/debug/profile"):
+                                                      "/debug/profile",
+                                                      "/debug/heap",
+                                                      "/debug/traces"):
                     # /metrics stays open (scrapers); the debug
                     # endpoints burn CPU / dump internals, so they go
                     # through the authenticator like any other route
@@ -228,6 +230,53 @@ class JsonRpcServer:
                             })
                             return
                         data = _sample_profile(secs).encode()
+                    elif plain_path == "/debug/heap":
+                        # heap profile (reference: pprof heap via
+                        # debugutil/): tracemalloc top allocation sites.
+                        # First call arms tracing (small overhead until
+                        # ?stop=1); subsequent calls report top sites.
+                        from urllib.parse import parse_qs, urlparse
+
+                        import tracemalloc
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        if qs.get("stop", ["0"])[0] in ("1", "true"):
+                            tracemalloc.stop()
+                            data = b"tracemalloc stopped\n"
+                        elif not tracemalloc.is_tracing():
+                            tracemalloc.start(12)
+                            data = (b"tracemalloc started; call again "
+                                    b"for the report (?stop=1 to end)\n")
+                        else:
+                            snap = tracemalloc.take_snapshot()
+                            stats = snap.statistics("lineno")
+                            total = sum(s.size for s in stats)
+                            lines = [
+                                f"heap: {total / 1048576:.1f} MiB traced "
+                                f"across {len(stats)} sites; top 50:"
+                            ]
+                            for s in stats[:50]:
+                                lines.append(
+                                    f"{s.size / 1024:10.1f} KiB "
+                                    f"{s.count:8d} objs  "
+                                    f"{s.traceback[0].filename}:"
+                                    f"{s.traceback[0].lineno}"
+                                )
+                            data = "\n".join(lines).encode()
+                    elif plain_path == "/debug/traces":
+                        # finished-span store (reference: Jaeger query
+                        # UI; zero-egress container -> local ring +
+                        # this endpoint instead of a collector)
+                        from urllib.parse import parse_qs, urlparse
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        tid = qs.get("trace_id", [None])[0]
+                        spans = (
+                            outer.tracer.spans(trace_id=tid)
+                            if getattr(outer, "tracer", None) is not None
+                            else []
+                        )
+                        data = json.dumps({"spans": spans}).encode()
                     else:
                         # pprof-style live thread dump (reference:
                         # debugutil/pprofui goroutine profiles)

@@ -1,0 +1,143 @@
+"""Distributed tracing: spans, cross-process propagation, local store.
+
+The reference wires Jaeger/opentracing end-to-end (reference:
+cmd/vearch/startup.go:66-85 initJaeger; ps/handler_document.go:123-126
+extracts the span context from rpcx metadata; router request-id
+middleware, router/server.go:63-80). This container is zero-egress, so
+instead of shipping to a collector each process keeps a bounded ring of
+finished spans, queryable via `GET /debug/traces` on every role, with
+an optional JSONL file export in an OTLP-like shape.
+
+Propagation rides the request envelope (`_trace_ctx` in the RPC body) —
+the envelope is this framework's rpcx-metadata equivalent; handlers
+never see transport headers.
+
+A span is sampled when the client asked (`trace: true`) or the role's
+`trace_sample` probability fires (reference: sampler type/param from the
+[tracer] config block).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any
+
+
+class Span:
+    __slots__ = (
+        "tracer", "trace_id", "span_id", "parent_id", "name", "service",
+        "start_us", "dur_us", "tags", "status",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: str | None, tags: dict | None):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.name = name
+        self.service = tracer.service
+        self.start_us = int(time.time() * 1e6)
+        self.dur_us = 0
+        self.tags: dict[str, Any] = dict(tags or {})
+        self.status = "ok"
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def ctx(self) -> dict:
+        """The propagation payload for downstream RPC bodies."""
+        return {"trace_id": self.trace_id, "parent": self.span_id}
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.status = f"error: {type(exc).__name__}"
+        self.dur_us = int(time.time() * 1e6) - self.start_us
+        self.tracer._finish(self)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "service": self.service,
+            "start_us": self.start_us,
+            "duration_us": self.dur_us,
+            "tags": self.tags,
+            "status": self.status,
+        }
+
+
+class Tracer:
+    """Per-process span factory + bounded finished-span store."""
+
+    def __init__(self, service: str, max_spans: int = 2048,
+                 sample_rate: float = 0.0, export_path: str | None = None):
+        self.service = service
+        self.sample_rate = float(sample_rate)
+        self.export_path = export_path
+        self._spans: deque[dict] = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+
+    def should_sample(self, explicit: bool) -> bool:
+        return explicit or (
+            self.sample_rate > 0 and random.random() < self.sample_rate
+        )
+
+    def span(self, name: str, ctx: dict | None = None,
+             tags: dict | None = None) -> Span:
+        """Start a span; `ctx` is an incoming `_trace_ctx` payload (or
+        None for a root span)."""
+        trace_id = (ctx or {}).get("trace_id") or uuid.uuid4().hex
+        parent = (ctx or {}).get("parent")
+        return Span(self, name, trace_id, parent, tags)
+
+    def _finish(self, span: Span) -> None:
+        d = span.to_dict()
+        with self._lock:
+            self._spans.append(d)
+        if self.export_path:
+            try:
+                with open(self.export_path, "a") as f:
+                    f.write(json.dumps(d) + "\n")
+            except OSError:
+                pass
+
+    def spans(self, trace_id: str | None = None,
+              limit: int = 200) -> list[dict]:
+        with self._lock:
+            items = list(self._spans)
+        if trace_id:
+            items = [s for s in items if s["trace_id"] == trace_id]
+        return items[-limit:]
+
+
+class NullSpan:
+    """No-op stand-in so call sites stay branch-free."""
+
+    trace_id = ""
+    span_id = ""
+
+    def set_tag(self, key, value):
+        pass
+
+    def ctx(self):
+        return None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+NULL_SPAN = NullSpan()

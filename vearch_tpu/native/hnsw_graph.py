@@ -104,6 +104,10 @@ class HnswGraph:
         # ndarrays satisfy the y* buffer protocol directly — no tobytes
         # copy (the graph's target regime is beyond-HBM batches)
         rows = np.ascontiguousarray(rows, dtype=np.float32)
+        if rows.ndim != 2 or rows.shape[1] != self.dim:
+            raise ValueError(
+                f"rows must be [b, {self.dim}], got {rows.shape}"
+            )
         with self._rw:
             return int(self._mod.hnsw_add(self._h, rows, rows.shape[0]))
 
@@ -117,6 +121,10 @@ class HnswGraph:
         """Returns (scores [B, k] similarity-oriented, ids [B, k] i64;
         -inf/-1 padding). `valid_mask` is a bool array over docids."""
         q = np.ascontiguousarray(queries, dtype=np.float32)
+        if q.ndim != 2 or q.shape[1] != self.dim:
+            raise ValueError(
+                f"queries must be [B, {self.dim}], got {q.shape}"
+            )
         b = q.shape[0]
         v = None
         if valid_mask is not None:
