@@ -16,7 +16,7 @@ from vearch_tpu.sdk.client import VearchClient
 D = 8
 
 
-def make_masters(tmp_path, n=3, timeout=1.0, _attempt=0):
+def make_masters(tmp_path, n=3, timeout=1.0, _attempt=0, **kw):
     ids = list(range(1, n + 1))
     masters = []
     # per-attempt subdirectory: a retry must not share persist/WAL files
@@ -27,7 +27,7 @@ def make_masters(tmp_path, n=3, timeout=1.0, _attempt=0):
             persist_path=str(base / f"m{i}" / "meta.json"),
             meta_dir=str(base / f"m{i}"),
             node_id=i, peers={j: "" for j in ids},
-            election_timeout=timeout, heartbeat_ttl=2.0,
+            election_timeout=timeout, heartbeat_ttl=2.0, **kw,
         )
         masters.append(m)
     addrs = {m.node_id: m.addr for m in masters}
@@ -47,7 +47,7 @@ def make_masters(tmp_path, n=3, timeout=1.0, _attempt=0):
                 pass
         if _attempt >= 1:
             raise
-        return make_masters(tmp_path, n, timeout, _attempt + 1)
+        return make_masters(tmp_path, n, timeout, _attempt + 1, **kw)
     return masters
 
 
@@ -246,3 +246,67 @@ def test_multimaster_with_auth(tmp_path):
     finally:
         for m in masters:
             m.stop()
+
+
+def test_far_behind_master_catches_up_via_snapshot(tmp_path):
+    """A master behind the meta-log truncation horizon must converge via
+    full snapshot install, not log replay (reference: etcd snapshot
+    transfer to slow members; gammacb/snapshot.go analogue for the
+    metadata group)."""
+    masters = make_masters(tmp_path, meta_log_keep=8, meta_flush_every=10)
+    try:
+        wait_leader(masters)
+        rpc.call(multi_addr(masters), "POST", "/dbs/base")
+        victim = next(m for m in masters if not m.is_leader)
+        vid = victim.node_id
+        victim.stop()
+        alive = [m for m in masters if m is not victim]
+        # push the log far past keep=8 while the victim is down so its
+        # resume point is compacted away on the leader
+        for i in range(60):
+            rpc.call(multi_addr(alive), "POST", f"/dbs/fill{i}")
+        # wait for the checkpoint loop to truncate behind the horizon
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            leader = next((m for m in alive if m.is_leader), None)
+            if leader and leader.meta_node.wal.first_index > 10:
+                break
+            time.sleep(0.2)
+        leader = next(m for m in alive if m.is_leader)
+        assert leader.meta_node.wal.first_index > 10, "log never truncated"
+
+        vdir = victim.store._persist_path.rsplit("/", 1)[0]
+        # wipe the victim's state: a replacement/far-behind node joins
+        # with nothing and MUST receive a snapshot
+        import shutil
+
+        shutil.rmtree(vdir)
+        m2 = MasterServer(
+            persist_path=f"{vdir}/meta.json", meta_dir=vdir,
+            node_id=vid, peers=dict(victim.peers),
+            election_timeout=0.6, heartbeat_ttl=2.0,
+            meta_log_keep=8, meta_flush_every=10,
+        )
+        m2.peers[vid] = m2.addr
+        for m in alive:
+            m.peers[vid] = m2.addr
+        m2.start()
+        masters.append(m2)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if "/db/fill59" in m2.store.prefix("/db/"):
+                break
+            time.sleep(0.2)
+        dbs = set(m2.store.prefix("/db/"))
+        assert "/db/base" in dbs and "/db/fill59" in dbs
+        assert m2.meta_node.snapshots_installed >= 1, (
+            "far-behind master converged without a snapshot install — "
+            "the compacted log cannot have replayed"
+        )
+        assert leader.meta_node.snapshots_sent >= 1
+    finally:
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass

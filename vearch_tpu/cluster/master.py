@@ -48,10 +48,17 @@ class MasterServer:
         peers: dict[int, str] | None = None,
         meta_dir: str | None = None,
         election_timeout: float = 1.0,
+        meta_log_keep: int = 1000,
+        meta_flush_every: int = 500,
     ):
         from vearch_tpu.cluster.auth import AuthService, parse_basic_auth
 
         self.heartbeat_ttl = heartbeat_ttl
+        # meta checkpoint cadence + retained log tail (reference: etcd
+        # snapshot-count / compaction knobs); small values in tests
+        # force the far-behind-master snapshot path
+        self.meta_log_keep = meta_log_keep
+        self.meta_flush_every = meta_flush_every
         self.auto_recover = auto_recover
         self.recover_delay = recover_delay
         self.store = MetaStore(persist_path)
@@ -231,7 +238,7 @@ class MasterServer:
     def _election_loop(self) -> None:
         import sys
 
-        keep = 1000  # log tail kept behind meta snapshots
+        keep = self.meta_log_keep  # log tail kept behind meta snapshots
         last_flush = 0
         while not self._stop.is_set():
             time.sleep(max(0.05, self.election_timeout / 4))
@@ -251,7 +258,7 @@ class MasterServer:
                 self._was_leader = leader_now
                 # periodic meta checkpoint + log truncation
                 node = self.meta_node
-                if node.applied - last_flush >= 500:
+                if node.applied - last_flush >= self.meta_flush_every:
                     with node._apply_lock:
                         self.store.applied_index = node.applied
                         self.store._persist()
@@ -762,25 +769,87 @@ class MasterServer:
         import json as _json
         import re as _re
 
-        if command == "create":
-            version = self.store.next_id(f"/seq/backup/{db}/{name}")
-            prefix = f"{base_prefix}/v{version}"
-            # space metadata rides with the backup for cross-cluster restore
-            ostore.put_bytes(f"{prefix}/space.json",
-                             _json.dumps(space.to_dict()).encode())
-            results = []
-            for i, part in enumerate(sorted(space.partitions,
-                                            key=lambda p: p.slot)):
-                srv = servers.get(part.leader)
-                if srv is None:
-                    raise RpcError(503, f"leader of partition {part.id} down")
-                results.append(rpc.call(srv.rpc_addr, "POST", "/ps/backup", {
-                    "partition_id": part.id,
-                    "store_root": body.get("store_root"),
-                    "store": body.get("store"),
-                    "key_prefix": f"{prefix}/shard_{i}",
-                }))
-            return {"version": version, "partitions": results}
+        # content-addressed dedup across versions is the default
+        # (reference: ref-counted shard files, ps/backup/
+        # ref_count_manager.go); dedup=false keeps the flat layout
+        dedup = bool(body.get("dedup", True))
+
+        if command in ("create", "delete"):
+            # serialise pool mutations per space: refs.json is a read-
+            # modify-write on the PSes (create) and here (delete); two
+            # concurrent commands would drop each other's ref updates
+            # and a later GC could orphan a valid version
+            import uuid as _uuid
+
+            lock_owner = _uuid.uuid4().hex
+            if not self.store.try_lock(f"backup/{db}/{name}", lock_owner,
+                                       ttl_s=600.0):
+                raise RpcError(409, f"backup for {db}/{name} in progress")
+        try:
+            if command == "create":
+                version = self.store.next_id(f"/seq/backup/{db}/{name}")
+                prefix = f"{base_prefix}/v{version}"
+                # space metadata rides with the backup for
+                # cross-cluster restore
+                ostore.put_bytes(f"{prefix}/space.json",
+                                 _json.dumps(space.to_dict()).encode())
+                results = []
+                for i, part in enumerate(sorted(space.partitions,
+                                                key=lambda p: p.slot)):
+                    srv = servers.get(part.leader)
+                    if srv is None:
+                        raise RpcError(
+                            503, f"leader of partition {part.id} down"
+                        )
+                    results.append(
+                        rpc.call(srv.rpc_addr, "POST", "/ps/backup", {
+                            "partition_id": part.id,
+                            "store_root": body.get("store_root"),
+                            "store": body.get("store"),
+                            "key_prefix": f"{prefix}/shard_{i}",
+                            "pool_prefix": (
+                                f"{base_prefix}/pool/shard_{i}"
+                                if dedup else None
+                            ),
+                        })
+                    )
+                return {"version": version, "partitions": results}
+
+            if command == "delete":
+                from vearch_tpu.cluster.objectstore import DEDUP_MANIFEST
+
+                version = int(body["version"])
+                prefix = f"{base_prefix}/v{version}"
+                try:
+                    bmeta = _json.loads(
+                        ostore.get_bytes(f"{prefix}/space.json")
+                    )
+                except (FileNotFoundError, KeyError) as e:
+                    raise RpcError(
+                        404, f"backup v{version} not found"
+                    ) from e
+                results = []
+                # shard count from the BACKUP's metadata: the live
+                # space may have been recreated with a different
+                # partition_num, and missing a shard would leak its
+                # blobs' refs forever
+                for i in range(len(bmeta["partitions"])):
+                    shard = f"{prefix}/shard_{i}"
+                    if ostore.exists(f"{shard}/{DEDUP_MANIFEST}"):
+                        results.append(ostore.delete_tree_dedup(
+                            shard, f"{base_prefix}/pool/shard_{i}"
+                        ))
+                    else:
+                        results.append({"flat": True})
+                for key in ostore.list(prefix.rstrip("/") + "/"):
+                    try:
+                        ostore.delete(key)
+                    except (FileNotFoundError, IOError):
+                        pass
+                return {"version": version, "shards": results}
+        finally:
+            if command in ("create", "delete"):
+                self.store.unlock(f"backup/{db}/{name}", lock_owner)
 
         if command == "list":
             versions = sorted({
@@ -815,6 +884,13 @@ class MasterServer:
                 # to the backup state (each clears its own log), or the
                 # followers would silently keep the pre-restore data
                 out = None
+                from vearch_tpu.cluster.objectstore import DEDUP_MANIFEST
+
+                # layout auto-detection: versions written with dedup
+                # carry a dedup manifest; flat ones a plain MANIFEST
+                dd = ostore.exists(
+                    f"{prefix}/shard_{i}/{DEDUP_MANIFEST}"
+                )
                 for r in part.replicas:
                     srv = servers.get(r)
                     if srv is None:
@@ -824,6 +900,9 @@ class MasterServer:
                         "store_root": body.get("store_root"),
                         "store": body.get("store"),
                         "key_prefix": f"{prefix}/shard_{i}",
+                        "pool_prefix": (
+                            f"{base_prefix}/pool/shard_{i}" if dd else None
+                        ),
                     })
                     if r == part.leader:
                         out = res

@@ -848,6 +848,13 @@ class PSServer:
         store = self._backup_store(body)
         with tempfile.TemporaryDirectory() as tmp:
             eng.dump(tmp)
+            if body.get("pool_prefix"):
+                # content-addressed dedup across versions (reference:
+                # ref_count_manager.go ref-counted shard files)
+                out = store.put_tree_dedup(
+                    body["key_prefix"], tmp, body["pool_prefix"]
+                )
+                return {"partition_id": pid, **out}
             n = store.put_tree(body["key_prefix"], tmp)
         return {"partition_id": pid, "files": n}
 
@@ -867,7 +874,12 @@ class PSServer:
         stage = tempfile.mkdtemp(prefix=f"partition_{pid}.restore.",
                                  dir=self.data_dir)
         try:
-            n = store.get_tree(body["key_prefix"], stage)
+            if body.get("pool_prefix"):
+                n = store.get_tree_dedup(
+                    body["key_prefix"], stage, body["pool_prefix"]
+                )
+            else:
+                n = store.get_tree(body["key_prefix"], stage)
             with self._flush_locks.setdefault(pid, threading.Lock()), \
                     node._apply_lock:
                 eng.close()

@@ -85,6 +85,9 @@ class RaftNode:
 
         # incoming snapshot staging: sid -> {chunks, snap_index, term}
         self._snap_in: dict[str, dict] = {}
+        # observability (parity checks + tests assert the catch-up path)
+        self.snapshots_sent = 0
+        self.snapshots_installed = 0
 
         # -- voted election mode (metadata groups; data partitions keep
         # master-arbitrated fencing). Standard raft: randomized timeout,
@@ -125,6 +128,8 @@ class RaftNode:
                 "leader_hint": self.node_id if self.is_leader
                 else self.leader_hint,
                 "members": list(self.members),
+                "snapshots_sent": self.snapshots_sent,
+                "snapshots_installed": self.snapshots_installed,
             }
 
     # -- leader: propose + replicate -----------------------------------------
@@ -302,6 +307,7 @@ class RaftNode:
         with self._lock:
             self._match[peer] = max(self._match.get(peer, 0), snap_index)
             self._next[peer] = snap_index + 1
+            self.snapshots_sent += 1
             self._advance_commit()
         return True
 
@@ -589,6 +595,7 @@ class RaftNode:
                 self.wal.reset(snap_index + 1)
                 self.wal.commit_index = snap_index
                 self.applied = snap_index
+                self.snapshots_installed += 1
                 self.wal.save_meta(fsync=True)
         return {"success": True, "term": self.term,
                 "last_index": self.wal.last_index}

@@ -46,9 +46,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--space", required=True)
     ap.add_argument("--user", default=None)
     ap.add_argument("--password", default=None)
-    ap.add_argument("command", choices=["create", "list", "restore"])
+    ap.add_argument("command",
+                    choices=["create", "list", "restore", "delete"])
     ap.add_argument("--version", type=int, default=None,
-                    help="backup version (restore)")
+                    help="backup version (restore/delete)")
     ap.add_argument("--store-root", default=None,
                     help="local/NFS object store root")
     ap.add_argument("--s3-endpoint", default=None)
@@ -62,9 +63,9 @@ def main(argv: list[str] | None = None) -> int:
     from vearch_tpu.cluster import rpc
 
     body = {"command": args.command, **build_store_spec(args)}
-    if args.command == "restore":
+    if args.command in ("restore", "delete"):
         if args.version is None:
-            raise SystemExit("restore needs --version")
+            raise SystemExit(f"{args.command} needs --version")
         body["version"] = args.version
     auth = (args.user, args.password) if args.user else None
     try:
