@@ -1,0 +1,167 @@
+"""SCANN / VEARCH index: anisotropic (score-aware) quantization.
+
+Reference: index/impl/scann/gamma_index_vearch.cc (VEARCH type wrapping
+ScaNN; params ncentroids/nsubvector/ns_threshold/reordering). The ops
+test verifies the trainer optimises the score-aware objective (not just
+MSE); the index tests gate recall like the other families.
+"""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+from vearch_tpu.ops import pq as pq_ops
+from vearch_tpu.ops import scann as scann_ops
+
+
+def _unit(x):
+    return x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True), 1e-15)
+
+
+def test_anisotropic_training_beats_plain_pq_on_score_loss():
+    rng = np.random.default_rng(3)
+    n, d, m = 8_000, 32, 8
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    u = _unit(x)
+    eta = scann_ops.eta_from_threshold(0.2, d)
+
+    plain = pq_ops.train_pq(x, m=m, ksub=64, iters=8)
+    plain_dec = pq_ops.decode_pq_np(
+        np.asarray(pq_ops.encode_pq(x, plain)), plain
+    )
+    aniso = scann_ops.train_anisotropic_pq(x, u, m=m, ksub=64, eta=eta,
+                                           iters=8)
+    codes = scann_ops.encode_anisotropic(x, u, aniso, eta)
+    aniso_dec = pq_ops.decode_pq_np(np.asarray(codes), aniso)
+
+    l_plain = scann_ops.anisotropic_loss(x, u, plain_dec, eta)
+    l_aniso = scann_ops.anisotropic_loss(x, u, aniso_dec, eta)
+    # the whole point of the technique: lower score-aware loss ...
+    assert l_aniso < l_plain, (l_aniso, l_plain)
+    # ... bought by shifting error off the parallel component
+    par_plain = float(np.mean(np.sum((x - plain_dec) * u, axis=-1) ** 2))
+    par_aniso = float(np.mean(np.sum((x - aniso_dec) * u, axis=-1) ** 2))
+    assert par_aniso < par_plain, (par_aniso, par_plain)
+
+
+def test_eta_from_threshold():
+    assert scann_ops.eta_from_threshold(0.0, 128) == 1.0
+    eta = scann_ops.eta_from_threshold(0.2, 128)
+    assert abs(eta - 127 * 0.04 / 0.96) < 1e-9
+
+
+N, D, NQ = 20_000, 64, 48
+
+
+@pytest.fixture(scope="module")
+def mips_dataset():
+    """Clustered unit-ish vectors; ground truth by exact inner product —
+    the regime anisotropic quantization is built for."""
+    rng = np.random.default_rng(9)
+    nc = 200
+    centers = (rng.standard_normal((nc, D)) * 3).astype(np.float32)
+    which = rng.integers(0, nc, N)
+    base = centers[which] + 0.7 * rng.standard_normal((N, D)).astype(
+        np.float32
+    )
+    q_idx = rng.choice(N, NQ, replace=False)
+    queries = base[q_idx] + 0.1 * rng.standard_normal((NQ, D)).astype(
+        np.float32
+    )
+    ip = queries.astype(np.float64) @ base.astype(np.float64).T
+    gt = np.argsort(-ip, axis=1)[:, :100]
+    return base, queries, gt
+
+
+def _build(base, metric, extra=None):
+    schema = TableSchema("s", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("SCANN", metric, {
+                        "ncentroids": 128, "nsubvector": 16,
+                        "train_iters": 5, "training_threshold": N,
+                        **(extra or {}),
+                    })),
+    ])
+    eng = Engine(schema)
+    for i in range(0, N, 10_000):
+        eng.upsert([{"_id": str(j), "v": base[j]}
+                    for j in range(i, i + 10_000)])
+    eng.build_index()
+    return eng
+
+
+def _recalls(eng, queries, gt, params=None):
+    req = SearchRequest(vectors={"v": queries}, k=100, include_fields=[],
+                        index_params=params or {})
+    res = eng.search(req)
+    got = [[int(it.key) for it in r.items] for r in res]
+    return {
+        k: float(np.mean([
+            len(set(got[q][:k]) & set(gt[q][:k].tolist())) / k
+            for q in range(len(got))
+        ]))
+        for k in (1, 10, 100)
+    }
+
+
+def test_recall_scann_mips(mips_dataset):
+    base, queries, gt = mips_dataset
+    eng = _build(base, MetricType.INNER_PRODUCT)
+    r = _recalls(eng, queries, gt, {"rerank": 256})
+    assert r[100] >= 0.9 and r[10] >= 0.8 and r[1] >= 0.5, r
+
+
+def test_scann_vearch_alias_and_reordering_off(mips_dataset):
+    base, queries, gt = mips_dataset
+    schema = TableSchema("s2", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("VEARCH", MetricType.INNER_PRODUCT, {
+                        "ncentroids": 128, "nsubvector": 16,
+                        "train_iters": 5, "training_threshold": N,
+                        "reordering": False,
+                    })),
+    ])
+    eng = Engine(schema)
+    for i in range(0, N, 10_000):
+        eng.upsert([{"_id": str(j), "v": base[j]}
+                    for j in range(i, i + 10_000)])
+    eng.build_index()
+    # quantized-only scores (no exact rerank) still clear a softer gate
+    r = _recalls(eng, queries, gt)
+    assert r[10] >= 0.6, r
+
+
+def test_scann_dump_load_roundtrip(mips_dataset, tmp_path):
+    base, queries, gt = mips_dataset
+    eng = _build(base, MetricType.INNER_PRODUCT)
+    r1 = _recalls(eng, queries, gt, {"rerank": 256})
+    eng.dump(str(tmp_path))
+    eng2 = Engine.open(str(tmp_path))
+    r2 = _recalls(eng2, queries, gt, {"rerank": 256})
+    assert abs(r2[10] - r1[10]) < 0.05, (r1, r2)
+
+
+def test_scann_default_nsubvector_clamps_to_dimension():
+    schema = TableSchema("s3", [
+        FieldSchema("v", DataType.VECTOR, dimension=48,
+                    index=IndexParams("SCANN", MetricType.L2, {
+                        "ncentroids": 16, "training_threshold": 1000,
+                        "train_iters": 2,
+                    })),
+    ])
+    eng = Engine(schema)
+    m = eng.indexes["v"].m
+    assert m > 0 and 48 % m == 0, m
+    # the schema object the caller owns is NOT mutated by the clamp
+    assert "nsubvector" not in schema.fields[0].index.params
+    rng = np.random.default_rng(0)
+    eng.upsert([{"_id": str(j), "v": rng.standard_normal(48)}
+                for j in range(1200)])
+    eng.build_index()
+    res = eng.search(SearchRequest(
+        vectors={"v": rng.standard_normal((4, 48))}, k=5, include_fields=[]
+    ))
+    assert len(res) == 4 and len(res[0].items) == 5

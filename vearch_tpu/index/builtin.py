@@ -6,4 +6,5 @@ import vearch_tpu.index.disk  # noqa: F401
 import vearch_tpu.index.flat  # noqa: F401
 import vearch_tpu.index.hnsw  # noqa: F401
 import vearch_tpu.index.ivf  # noqa: F401
+import vearch_tpu.index.scann  # noqa: F401
 import vearch_tpu.index.sharded_flat  # noqa: F401

@@ -335,11 +335,25 @@ class IVFPQIndex(_IVFBase):
                 R = (u @ vt).astype(np.float32)
             self._opq_R = R
             resid = resid @ R
-        self.codebooks = pq_ops.train_pq(
+        self.codebooks = self._fit_codebooks(resid, sample)
+        self._codes = np.zeros((0, self.m), dtype=np.uint8)
+
+    def _fit_codebooks(
+        self, resid: np.ndarray, sample: np.ndarray
+    ) -> jax.Array:
+        """Codebook trainer hook — SCANN overrides this with the
+        anisotropic (score-aware) trainer; `sample` is the original
+        (pre-residual) rows it needs for the parallel direction."""
+        return pq_ops.train_pq(
             jnp.asarray(resid), m=self.m, ksub=self.ksub,
             iters=self.train_iters,
         )
-        self._codes = np.zeros((0, self.m), dtype=np.uint8)
+
+    def _encode_rows(self, resid: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Encoder hook (same override seam as `_fit_codebooks`)."""
+        return np.asarray(
+            pq_ops.encode_pq(jnp.asarray(resid), self.codebooks)
+        )
 
     def _absorb_rows(
         self, rows: np.ndarray, assign: np.ndarray, start_docid: int
@@ -348,7 +362,7 @@ class IVFPQIndex(_IVFBase):
         resid = rows - cents[assign]
         if self._opq_R is not None:
             resid = resid @ self._opq_R  # encode in rotated space
-        codes = np.asarray(pq_ops.encode_pq(jnp.asarray(resid), self.codebooks))
+        codes = self._encode_rows(resid, rows)
         if self._codes is None:
             self._codes = np.zeros((0, self.m), dtype=np.uint8)
         need = start_docid + rows.shape[0]
