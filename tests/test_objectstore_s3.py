@@ -370,3 +370,39 @@ def test_cluster_backup_dedup_via_s3(mock_s3, tmp_path, rng):
         with pytest.raises(rpc.RpcError, match="not found"):
             rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
                      {"command": "restore", "store": spec, "version": 1})
+
+
+def test_dedup_delete_scrubs_refs_without_manifest(tmp_path):
+    """A backup that crashed between incref and the manifest write
+    leaves refs naming a version that has no manifest; deleting that
+    version must still decref (gating on the manifest would pin every
+    blob it touched — including ones shared with healthy versions —
+    behind a phantom holder forever)."""
+    import json
+
+    from vearch_tpu.cluster.objectstore import (
+        DEDUP_MANIFEST, REFS, LocalObjectStore,
+    )
+
+    store = LocalObjectStore(str(tmp_path / "store"))
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "shared.bin").write_bytes(b"shared" * 5000)
+    store.put_tree_dedup("c/v1", str(src), "c/pool")
+
+    # simulate the crash window: v2 increfs the shared blob but never
+    # writes its manifest or any blobs of its own
+    refs = json.loads(store.get_bytes(f"c/pool/{REFS}"))
+    for holders in refs.values():
+        holders.append("c/v2")
+    store.put_bytes(f"c/pool/{REFS}", json.dumps(refs).encode())
+    assert not store.exists(f"c/v2/{DEDUP_MANIFEST}")
+
+    # deleting the crashed version removes the phantom holder
+    store.delete_tree_dedup("c/v2", "c/pool")
+    refs = json.loads(store.get_bytes(f"c/pool/{REFS}"))
+    assert all("c/v2" not in h for h in refs.values())
+
+    # ... so deleting the healthy version now really GCs the blob
+    res = store.delete_tree_dedup("c/v1", "c/pool")
+    assert res["blobs_deleted"] == 1 and res["blobs_kept"] == 0

@@ -816,8 +816,6 @@ class MasterServer:
                 return {"version": version, "partitions": results}
 
             if command == "delete":
-                from vearch_tpu.cluster.objectstore import DEDUP_MANIFEST
-
                 version = int(body["version"])
                 prefix = f"{base_prefix}/v{version}"
                 try:
@@ -835,12 +833,15 @@ class MasterServer:
                 # blobs' refs forever
                 for i in range(len(bmeta["partitions"])):
                     shard = f"{prefix}/shard_{i}"
-                    if ostore.exists(f"{shard}/{DEDUP_MANIFEST}"):
-                        results.append(ostore.delete_tree_dedup(
-                            shard, f"{base_prefix}/pool/shard_{i}"
-                        ))
-                    else:
-                        results.append({"flat": True})
+                    # always decref: delete_tree_dedup scrubs this
+                    # version from every pool ref (a crash between
+                    # incref and manifest write leaves refs with no
+                    # manifest — gating on the manifest would pin those
+                    # blobs forever); flat backups have an empty pool,
+                    # so the scrub is a no-op for them
+                    results.append(ostore.delete_tree_dedup(
+                        shard, f"{base_prefix}/pool/shard_{i}"
+                    ))
                 for key in ostore.list(prefix.rstrip("/") + "/"):
                     try:
                         ostore.delete(key)

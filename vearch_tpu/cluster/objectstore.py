@@ -69,11 +69,11 @@ class ObjectStore:
             f.write(self.get_bytes(key))
 
     def exists(self, key: str) -> bool:
-        try:
-            self.get_bytes(key)
-            return True
-        except (FileNotFoundError, IOError, KeyError):
-            return False
+        # abstract on purpose: a get_bytes-based fallback would download
+        # whole blobs per probe and read transient store errors as
+        # "absent", silently re-uploading (or worse, GC'ing) under
+        # faults — every backend must answer existence natively
+        raise NotImplementedError
 
     def delete(self, key: str) -> None:
         raise NotImplementedError
@@ -166,29 +166,39 @@ class ObjectStore:
 
     def delete_tree_dedup(self, version_prefix: str,
                           pool_prefix: str) -> dict:
-        """Drop a version: decref its blobs, garbage-collect blobs no
-        other version holds (reference: ref_count_manager.go decref +
-        cleanup)."""
-        try:
-            manifest = json.loads(
-                self.get_bytes(f"{version_prefix}/{DEDUP_MANIFEST}")
-            )
-        except (KeyError, FileNotFoundError):
-            manifest = {}
+        """Drop a version: decref every pool ref naming it,
+        garbage-collect blobs no other version holds (reference:
+        ref_count_manager.go decref + cleanup)."""
+        # scrub this version from EVERY refs entry, not just the hashes
+        # its manifest names: incref runs before the manifest write, so
+        # a backup that crashed in that window has refs but no manifest —
+        # keying decref on the manifest would pin its blobs (and any it
+        # shares with healthy versions) behind a phantom holder forever
         refs = self._read_refs(pool_prefix)
         deleted = 0
-        for h in {meta["sha256"] for meta in manifest.values()}:
-            holders = refs.get(h, [])
+        changed = False
+        for h in list(refs):
+            holders = refs[h]
             if version_prefix in holders:
                 holders.remove(version_prefix)
+                changed = True
             if not holders:
-                refs.pop(h, None)
+                # drop the refs entry only once the blob is actually
+                # gone: a transient store error must leave the empty
+                # entry behind so the NEXT delete call retries the GC
+                # instead of orphaning the blob forever
                 try:
                     self.delete(f"{pool_prefix}/blobs/{h}")
                     deleted += 1
-                except (FileNotFoundError, KeyError, IOError):
-                    pass
-        self.put_bytes(f"{pool_prefix}/{REFS}", json.dumps(refs).encode())
+                except (FileNotFoundError, KeyError):
+                    pass  # already gone
+                except IOError:
+                    continue
+                refs.pop(h, None)
+                changed = True
+        if changed or deleted:
+            self.put_bytes(f"{pool_prefix}/{REFS}",
+                           json.dumps(refs).encode())
         for key in self.list(version_prefix.rstrip("/") + "/"):
             try:
                 self.delete(key)

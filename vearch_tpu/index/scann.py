@@ -68,6 +68,12 @@ class ScannIndex(IVFPQIndex):
         ))
 
     def _rerank_depth(self, k: int, params: dict | None) -> int:
-        if not self.reordering and not (params or {}).get("rerank"):
+        # an explicit rerank depth — request OR index level — overrides
+        # reordering=false, matching the base class's lookup order
+        if (
+            not self.reordering
+            and not (params or {}).get("rerank")
+            and not self.params.get("rerank")
+        ):
             return k  # reordering=false: trust the quantized scores
         return super()._rerank_depth(k, params)
