@@ -34,6 +34,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="partition servers in standalone mode")
     args = ap.parse_args(argv)
 
+    from vearch_tpu.utils import log
+
     if args.conf:
         from vearch_tpu.cluster.config import Config
 
@@ -47,6 +49,14 @@ def main(argv: list[str] | None = None) -> int:
             else args.data_dir
         args.auth = args.auth or cfg.auth
         args.root_password = cfg.root_password
+        # per-role rotating file log + stderr (reference: [global] log
+        # dir + level, pkg/log rotating writer)
+        log.init(args.role, log_dir=cfg.log_dir, level=cfg.log_level)
+    else:
+        import os
+
+        log.init(args.role, log_dir=None,
+                 level=os.environ.get("VEARCH_LOG_LEVEL", "info"))
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())

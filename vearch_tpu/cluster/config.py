@@ -73,6 +73,19 @@ class Config:
         return self.global_.get("data", "./vearch_data")
 
     @property
+    def log_level(self) -> str:
+        """Reference: [global] level (config.go GetLogInfoWriteSwitch)."""
+        return str(self.global_.get("log_level", "info"))
+
+    @property
+    def log_dir(self) -> str:
+        import os
+
+        return str(self.global_.get(
+            "log", os.path.join(self.data_dir, "logs")
+        ))
+
+    @property
     def auth(self) -> bool:
         return bool(self.global_.get("auth", False))
 

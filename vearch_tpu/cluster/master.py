@@ -29,6 +29,9 @@ from vearch_tpu.cluster.hashing import carve_slots
 from vearch_tpu.cluster.metastore import MetaStore
 from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
 from vearch_tpu.engine.types import TableSchema
+from vearch_tpu.utils import log
+
+_log = log.get("master")
 
 HEARTBEAT_TTL = 8.0
 
@@ -236,8 +239,6 @@ class MasterServer:
             self.store.put(key, val, lease=lease)
 
     def _election_loop(self) -> None:
-        import sys
-
         keep = self.meta_log_keep  # log tail kept behind meta snapshots
         last_flush = 0
         while not self._stop.is_set():
@@ -268,16 +269,13 @@ class MasterServer:
                         max(node.wal.first_index, node.applied - keep + 1)
                     )
             except Exception as e:
-                print(f"[master {self.node_id}] election tick failed: "
-                      f"{type(e).__name__}: {e}", file=sys.stderr,
-                      flush=True)
+                _log.error("master %s: election tick failed: %s: %s",
+                           self.node_id, type(e).__name__, e)
 
     def _on_promoted(self) -> None:
         """Leadership acquisition: bootstrap auth records and re-lease
         persisted servers. Retries while we stay leader — each op is a
         quorum write that can transiently fail during churn."""
-        import sys
-
         for _ in range(40):
             if self._stop.is_set() or not self.is_leader:
                 return
@@ -287,8 +285,8 @@ class MasterServer:
                 return
             except (RpcError, ValueError) as e:
                 # ValueError: wal closed by a concurrent stop()
-                print(f"[master {self.node_id}] promotion work retrying: "
-                      f"{str(e)[:60]}", file=sys.stderr, flush=True)
+                _log.warning("master %s: promotion work retrying: %s",
+                             self.node_id, str(e)[:60])
                 time.sleep(0.3)
 
     def start(self) -> None:
@@ -314,8 +312,6 @@ class MasterServer:
     # -- failure detection (reference: master_cache.go:963-1005) -------------
 
     def _lease_reaper(self) -> None:
-        import sys
-
         tick = min(1.0, self.heartbeat_ttl / 4)
         while not self._stop.is_set():
             time.sleep(tick)
@@ -335,9 +331,8 @@ class MasterServer:
                 # store mutations propose through the meta log and can
                 # transiently 421/503 during leadership churn — the
                 # failure-detection thread must survive that
-                print(f"[master {self.node_id}] lease reap failed: "
-                      f"{type(e).__name__}: {e}", file=sys.stderr,
-                      flush=True)
+                _log.error("master %s: lease reap failed: %s: %s",
+                           self.node_id, type(e).__name__, e)
 
     def _failover_node(self, dead_node: int) -> None:
         """Reconfigure every partition hosted on the dead node: fence all
@@ -417,8 +412,6 @@ class MasterServer:
     #    after replica_auto_recover_time) -----------------------------------
 
     def _auto_recover_loop(self) -> None:
-        import sys
-
         while not self._stop.is_set():
             time.sleep(1.0)
             if not self.is_leader:
@@ -427,8 +420,8 @@ class MasterServer:
                 with self._reconfig_lock:
                     self._auto_recover_once()
             except Exception as e:
-                print(f"[master] auto-recover pass failed: "
-                      f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+                _log.error("auto-recover pass failed: %s: %s",
+                           type(e).__name__, e)
 
     def _auto_recover_once(self) -> None:
         servers = {s.node_id: s for s in self._alive_servers()}
@@ -695,7 +688,18 @@ class MasterServer:
         sp = self.store.get(f"{PREFIX_SPACE}{db}/{name}")
         if sp is None:
             raise RpcError(404, f"space {db}/{name} not found")
+        if "log_level" in body:
+            # validate BEFORE persisting/fanning out: a typo'd level
+            # must reject the whole request, not store junk config
+            try:
+                log.parse_level(str(body["log_level"]))
+            except ValueError as e:
+                raise RpcError(400, str(e)) from None
         self.store.put(f"/config/{db}/{name}", body)
+        if "log_level" in body:
+            # the master applies the flip to itself too before fanning
+            # the config out to the space's PS nodes
+            log.set_level(str(body["log_level"]))
         space = Space.from_dict(sp)
         servers = {s.node_id: s for s in self._alive_servers()}
         applied = []

@@ -28,6 +28,9 @@ from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Partition
 from vearch_tpu.cluster.raft import RaftNode
 from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+from vearch_tpu.utils import log
+
+_log = log.get("ps")
 
 # log entries retained behind the flushed/applied horizon so a briefly
 # lagging follower catches up by replay instead of full snapshot
@@ -276,11 +279,8 @@ class PSServer:
                 node.recover_singleton_commit()
                 node._apply_to_commit()
             except Exception as e:
-                import sys
-
-                print(f"[ps {self.node_id}] recover partition {pid} "
-                      f"failed: {type(e).__name__}: {e}",
-                      file=sys.stderr, flush=True)
+                _log.error("ps %s: recover partition %s failed: %s: %s",
+                           self.node_id, pid, type(e).__name__, e)
 
     # -- raft plumbing -------------------------------------------------------
 
@@ -399,8 +399,6 @@ class PSServer:
     #    applied SN; :40 truncate job trims the log behind it) --------------
 
     def _flush_loop(self) -> None:
-        import sys
-
         while not self._stop.is_set():
             time.sleep(self.flush_interval)
             for pid in list(self.raft_nodes):
@@ -413,9 +411,8 @@ class PSServer:
                 except Exception as e:
                     # a silently failing flush would stop checkpointing
                     # AND WAL truncation — always loud
-                    print(f"[ps {self.node_id}] flush partition {pid} "
-                          f"failed: {type(e).__name__}: {e}",
-                          file=sys.stderr, flush=True)
+                    _log.error("ps %s: flush partition %s failed: %s: %s",
+                               self.node_id, pid, type(e).__name__, e)
 
     def flush_partition(self, pid: int) -> int:
         """Checkpoint the engine with its applied index, then truncate
@@ -795,6 +792,13 @@ class PSServer:
 
     def _h_engine_config(self, body: dict, _parts) -> dict:
         cfg = body.get("config") or {}
+        if "log_level" in cfg:
+            # validate before mutating ANY key — a bad level must not
+            # leave the handler half-applied
+            try:
+                log.parse_level(str(cfg["log_level"]))
+            except ValueError as e:
+                raise RpcError(400, str(e)) from None
         if "memory_limit_mb" in cfg:
             self.memory_limit_mb = int(cfg["memory_limit_mb"])
         if "slow_request_ms" in cfg:
@@ -803,6 +807,10 @@ class PSServer:
         if "slow_route_ms" in cfg:
             # reference: slow-channel isolation threshold (ps/server.go:95)
             self.slow_route_ms = int(cfg["slow_route_ms"])
+        if "log_level" in cfg:
+            # runtime log-level flip, fanned out by the master's /config
+            # (reference: log-level runtime config in pkg/log)
+            log.set_level(str(cfg["log_level"]))
         eng = self._engine(body["partition_id"])
         return eng.apply_config(cfg)
 

@@ -30,6 +30,9 @@ from typing import Any, Callable
 import numpy as np
 
 from vearch_tpu.cluster.metrics import Registry
+from vearch_tpu.utils import log
+
+_log = log.get("rpc")
 
 JSON_CT = "application/json"
 BIN_CT = "application/x-vearch-tensors"
@@ -339,14 +342,23 @@ class JsonRpcServer:
                     self._reply(200, {"code": e.code, "msg": e.msg})
                 except Exception as e:  # panic recovery
                     code = 500
+                    _log.error("panic in %s %s: %s: %s\n%s", method,
+                               prefix, type(e).__name__, e,
+                               traceback.format_exc(limit=8))
                     self._reply(
                         500,
                         {"code": 500, "msg": f"{type(e).__name__}: {e}",
                          "trace": traceback.format_exc(limit=8)},
                     )
                 finally:
+                    dt = time.time() - t0
+                    # access log at debug (reference: request logs are
+                    # debug-gated; IsDebugEnabled avoids the format cost)
+                    if log.is_debug_enabled():
+                        _log.debug("%s %s -> %s %.1fms", method, prefix,
+                                   code, dt * 1e3)
                     outer._m_requests.inc(method, prefix, str(code))
-                    outer._m_latency.observe(time.time() - t0, method, prefix)
+                    outer._m_latency.observe(dt, method, prefix)
 
             def _reply(self, status: int, obj: dict):
                 ct, data = _encode(obj)
