@@ -1,0 +1,198 @@
+"""Query micro-batching: concurrent small searches share one device
+dispatch without changing any result (engine/microbatch.py; TPU-native
+addition — the reference's per-thread CPU scans have no analogue)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, RequestContext, RequestKilled, SearchRequest
+from vearch_tpu.engine.microbatch import MicroBatcher, _compat_key, _Pending, _rows_of
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+
+D, N = 16, 3000
+
+
+@pytest.fixture(scope="module")
+def engine_and_data():
+    rng = np.random.default_rng(2)
+    base = rng.standard_normal((N, D)).astype(np.float32)
+    schema = TableSchema("m", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": str(i), "v": base[i]} for i in range(N)])
+    eng.build_index()
+    yield eng, base
+    eng.close()
+
+
+def test_compat_key_distinguishes_params():
+    a = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5)
+    b = SearchRequest(vectors={"v": np.zeros((1, D))}, k=9)
+    c = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                      index_params={"nprobe": 4})
+    d = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5)
+    # k splits batches: the engine's candidate depth derives from it,
+    # so co-batching mixed k would change the small-k caller's results
+    assert _compat_key(a) != _compat_key(b)
+    assert _compat_key(a) != _compat_key(c)
+    assert _compat_key(a) == _compat_key(d)
+
+
+def test_dispatcher_survives_poison_request(engine_and_data):
+    """A request whose grouping key cannot be built fails loudly but the
+    dispatcher thread stays alive for later callers."""
+    eng, base = engine_and_data
+
+    class Unprintable:
+        def __str__(self):
+            raise RuntimeError("boom")
+
+    mb = MicroBatcher(eng, max_rows=64)
+    try:
+        bad = SearchRequest(vectors={"v": base[0]}, k=2,
+                            include_fields=[],
+                            index_params={"poison": Unprintable()})
+        with pytest.raises(Exception):
+            mb.submit(bad)
+        # the same batcher still serves well-formed requests
+        good = mb.submit(SearchRequest(vectors={"v": base[4]}, k=2,
+                                       include_fields=[]))
+        assert good[0].items[0].key == "4"
+    finally:
+        mb.stop()
+
+
+def test_grouping_respects_max_rows(engine_and_data):
+    eng, _ = engine_and_data
+    mb = MicroBatcher(eng, max_rows=3)
+    try:
+        reqs = [SearchRequest(vectors={"v": np.zeros((2, D))}, k=3)
+                for _ in range(3)]
+        groups = mb._group([_Pending(r, _rows_of(r)) for r in reqs])
+        # 2+2 rows fit in one group of max 3? no — 2, then 2 would
+        # exceed 3, so each lands alone except none combine
+        assert [len(g) for g in groups] == [1, 1, 1]
+        mb2 = MicroBatcher(eng, max_rows=4)
+        groups = mb2._group([_Pending(r, _rows_of(r)) for r in reqs])
+        assert [len(g) for g in groups] == [2, 1]
+        mb2.stop()
+    finally:
+        mb.stop()
+
+
+def test_batched_results_equal_direct(engine_and_data):
+    """The load-bearing property: batching never changes a result."""
+    eng, base = engine_and_data
+    rng = np.random.default_rng(7)
+    queries = [base[i] + 0.01 * rng.standard_normal(D).astype(np.float32)
+               for i in range(40)]
+    direct = [
+        eng._search_direct(SearchRequest(
+            vectors={"v": q}, k=5, include_fields=[]))
+        for q in queries
+    ]
+
+    out = [None] * len(queries)
+    errs = []
+
+    def worker(i):
+        try:
+            out[i] = eng.search(SearchRequest(
+                vectors={"v": queries[i]}, k=5, include_fields=[]))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(queries))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for i in range(len(queries)):
+        got = [(it.key, round(it.score, 4)) for it in out[i][0].items]
+        want = [(it.key, round(it.score, 4)) for it in direct[i][0].items]
+        assert got == want, (i, got, want)
+    # with 40 concurrent callers at least some dispatches combined
+    mb = eng._microbatcher
+    assert mb is not None and mb.batched_requests >= 2, (
+        mb.batches, mb.batched_requests
+    )
+
+
+def test_mixed_k_trimmed_per_caller(engine_and_data):
+    eng, base = engine_and_data
+    r3 = SearchRequest(vectors={"v": base[5]}, k=3, include_fields=[])
+    r7 = SearchRequest(vectors={"v": base[6]}, k=7, include_fields=[])
+    mb = MicroBatcher(eng, max_rows=64)
+    try:
+        p3, p7 = _Pending(r3, 1), _Pending(r7, 1)
+        mb._run_group([p3, p7])
+        assert p3.error is None and p7.error is None
+        assert len(p3.results[0].items) == 3
+        assert len(p7.results[0].items) == 7
+        assert p3.results[0].items[0].key == "5"
+        assert p7.results[0].items[0].key == "6"
+    finally:
+        mb.stop()
+
+
+def test_killed_subrequest_aborts_alone(engine_and_data):
+    eng, base = engine_and_data
+    ctx = RequestContext("r1")
+    ctx.kill("test kill")
+    rk = SearchRequest(vectors={"v": base[1]}, k=3, include_fields=[],
+                       ctx=ctx)
+    ro = SearchRequest(vectors={"v": base[2]}, k=3, include_fields=[])
+    mb = MicroBatcher(eng, max_rows=64)
+    try:
+        pk, po = _Pending(rk, 1), _Pending(ro, 1)
+        mb._run_group([pk, po])
+        assert isinstance(pk.error, RequestKilled)
+        assert po.error is None
+        assert po.results[0].items[0].key == "2"
+    finally:
+        mb.stop()
+
+
+def test_filtered_requests_bypass_batcher(engine_and_data):
+    eng, base = engine_and_data
+    schema = TableSchema("f", [
+        FieldSchema("tag", DataType.INT),
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    e2 = Engine(schema)
+    e2.upsert([{"_id": str(i), "tag": i % 2, "v": base[i]}
+               for i in range(200)])
+    e2.build_index()
+    res = e2.search(SearchRequest(
+        vectors={"v": base[3]}, k=4, include_fields=["tag"],
+        filters={"operator": "AND",
+                 "conditions": [{"field": "tag", "operator": "=",
+                                 "value": 1}]},
+    ))
+    assert all(r.fields["tag"] == 1 for r in res[0].items)
+    assert e2._microbatcher is None  # filtered path never started one
+    e2.close()
+
+
+def test_runtime_config_disables_batching(engine_and_data):
+    eng, base = engine_and_data
+    eng.apply_config({"micro_batch": False})
+    try:
+        eng.search(SearchRequest(vectors={"v": base[0]}, k=2,
+                                 include_fields=[]))
+        before = eng._microbatcher.batches if eng._microbatcher else 0
+        eng.search(SearchRequest(vectors={"v": base[0]}, k=2,
+                                 include_fields=[]))
+        after = eng._microbatcher.batches if eng._microbatcher else 0
+        assert before == after
+    finally:
+        eng.apply_config({"micro_batch": True})

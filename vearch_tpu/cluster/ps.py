@@ -930,6 +930,13 @@ class PSServer:
                     "doc_count": eng.doc_count,
                     "status": int(eng.status),
                     "memory_bytes": eng.memory_usage_bytes(),
+                    "micro_batches": (
+                        mb.batches if (mb := eng._microbatcher) is not None
+                        else 0
+                    ),
+                    "micro_batched_requests": (
+                        mb.batched_requests if mb is not None else 0
+                    ),
                     "raft": self.raft_nodes[pid].state()
                     if pid in self.raft_nodes else None,
                 }

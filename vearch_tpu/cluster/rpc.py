@@ -380,7 +380,14 @@ class JsonRpcServer:
             def do_DELETE(self):
                 self._serve("DELETE")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # stdlib default backlog is 5: a burst of concurrent
+            # clients (each rpc.call opens a fresh TCP connection)
+            # overflows it and the kernel RSTs the excess — observed as
+            # flaky "connection reset by peer" at ~64 parallel callers
+            request_queue_size = 512
+
+        self._httpd = Server((host, port), Handler)
         self._httpd.daemon_threads = True
         self.addr = f"{host}:{self._httpd.server_address[1]}"
         self._thread: threading.Thread | None = None

@@ -99,6 +99,11 @@ class Engine:
         self.indexes: dict[str, VectorIndex] = {}
         self.status = IndexStatus.UNINDEXED
         self._write_lock = threading.Lock()
+        # query micro-batching (engine/microbatch.py): lazily started on
+        # the first qualifying search so idle engines spawn no thread
+        self.micro_batch = True
+        self.micro_batch_max_rows = 1024
+        self._microbatcher = None
         self._scalar_manager = None
         if schema.composite_indexes or any(
             f.scalar_index.value != "NONE" for f in schema.scalar_fields()
@@ -318,6 +323,14 @@ class Engine:
     def close(self) -> None:
         if getattr(self, "_closed", None) is not None:
             self._closed.set()
+        # under _write_lock, mirroring the lazy creation in search():
+        # otherwise a concurrent search could construct a fresh batcher
+        # after this stop, leaking a dispatcher bound to a closed engine
+        with self._write_lock:
+            self.micro_batch = False
+            if self._microbatcher is not None:
+                self._microbatcher.stop()
+                self._microbatcher = None
 
     def apply_config(self, cfg: dict[str, Any]) -> dict[str, Any]:
         """Runtime-mutable engine config (reference: master /config API ->
@@ -328,6 +341,13 @@ class Engine:
             self.schema.refresh_interval_ms = int(cfg["refresh_interval_ms"])
         if "training_threshold" in cfg:
             self.schema.training_threshold = int(cfg["training_threshold"])
+        if "micro_batch" in cfg:
+            self.micro_batch = bool(cfg["micro_batch"])
+        if "micro_batch_max_rows" in cfg:
+            self.micro_batch_max_rows = int(cfg["micro_batch_max_rows"])
+            mb = self._microbatcher
+            if mb is not None:  # propagate to a live batcher
+                mb.max_rows = self.micro_batch_max_rows
         for name, params in (cfg.get("index_params") or {}).items():
             if name in self.indexes:
                 self.indexes[name].params.params.update(params)
@@ -387,6 +407,32 @@ class Engine:
         return self._mask_cache
 
     def search(self, req: SearchRequest) -> list[SearchResult]:
+        """Search entry: compatible concurrent requests are combined
+        into one device dispatch (engine/microbatch.py); filtered,
+        brute-force, and batching-disabled requests run directly."""
+        if (
+            self.micro_batch
+            and req.filters is None
+            and not req.brute_force
+            and req.vectors
+        ):
+            mb = self._microbatcher
+            if mb is None:
+                with self._write_lock:
+                    mb = self._microbatcher
+                    # re-check micro_batch under the lock: close() flips
+                    # it to False before stopping the batcher
+                    if mb is None and self.micro_batch:
+                        from vearch_tpu.engine.microbatch import MicroBatcher
+
+                        mb = self._microbatcher = MicroBatcher(
+                            self, max_rows=self.micro_batch_max_rows
+                        )
+            if mb is not None:
+                return mb.submit(req)
+        return self._search_direct(req)
+
+    def _search_direct(self, req: SearchRequest) -> list[SearchResult]:
         if not req.vectors:
             raise ValueError("search needs at least one vector field")
         n = self.table.doc_count

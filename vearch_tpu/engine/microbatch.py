@@ -1,0 +1,215 @@
+"""Server-side query micro-batching: concurrent small searches ride one
+device dispatch.
+
+TPU-native addition (no direct reference analogue — the reference's CPU
+engine runs each request on its own thread pool slot, which is the right
+shape for SIMD cores; reference: RequestConcurrentController,
+search/engine.h:197). On TPU the cost model inverts: a [1, N] and a
+[64, N] scan cost nearly the same device time because both are one
+MXU-bound program dispatch, so the winning schedule under concurrency is
+to COMBINE waiting queries into one batch.
+
+Design — dynamic batching, zero added latency when idle:
+- callers enqueue and block; a per-engine dispatcher thread drains
+  WHATEVER is queued the moment the previous device call finishes;
+- under low load a request finds the dispatcher idle and runs alone
+  (batch of 1 — no artificial wait window, unlike time-windowed
+  batching);
+- under load, requests naturally pile up while the device is busy and
+  the next drain combines them: throughput scales with batch size,
+  per-request latency stays ~one device-call.
+
+Only compatible requests combine (same field set / k / params /
+weights / include_fields, no filters, not brute-force): grouping never
+changes a result, only its schedule. A killed sub-request is dropped at
+result-split time — its company still gets answers, matching the kill
+switch's best-effort phase-boundary semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from vearch_tpu.engine.engine import Engine, SearchRequest, SearchResult
+
+
+class _Pending:
+    __slots__ = ("req", "rows", "done", "results", "error")
+
+    def __init__(self, req: "SearchRequest", rows: int):
+        self.req = req
+        self.rows = rows
+        self.done = threading.Event()
+        self.results: "list[SearchResult] | None" = None
+        self.error: Exception | None = None
+
+
+def _compat_key(req: "SearchRequest") -> str:
+    return json.dumps({
+        "fields": sorted(req.vectors),
+        # k is part of the key because the engine's candidate depth
+        # (fetch_k) derives from it — co-batching mixed k at max(k)
+        # would give the small-k caller a different candidate set than
+        # a solo run, breaking "grouping never changes a result"
+        "k": req.k,
+        "params": req.index_params or {},
+        "weights": req.field_weights or {},
+        "include": sorted(req.include_fields)
+        if req.include_fields is not None else None,
+    }, sort_keys=True, default=str)
+
+
+def _rows_of(req: "SearchRequest") -> int:
+    q = next(iter(req.vectors.values()))
+    q = np.asarray(q)
+    return 1 if q.ndim == 1 else int(q.shape[0])
+
+
+class MicroBatcher:
+    def __init__(self, engine: "Engine", max_rows: int = 1024):
+        self.engine = engine
+        self.max_rows = max_rows
+        self._lock = threading.Lock()
+        self._queue: list[_Pending] = []
+        self._wake = threading.Event()
+        self._stopped = False
+        # observability (surfaces in /ps/stats)
+        self.batches = 0
+        self.batched_requests = 0  # requests that shared a dispatch
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="vearch-microbatch"
+        )
+        self._thread.start()
+
+    # -- caller side ---------------------------------------------------------
+
+    def submit(self, req: "SearchRequest") -> "list[SearchResult]":
+        p = _Pending(req, _rows_of(req))
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("engine closed")
+            self._queue.append(p)
+        self._wake.set()
+        p.done.wait()
+        if p.error is not None:
+            raise p.error
+        assert p.results is not None
+        return p.results
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            pending, self._queue = self._queue, []
+        for p in pending:
+            p.error = RuntimeError("engine closed")
+            p.done.set()
+        self._wake.set()
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait()
+            with self._lock:
+                if self._stopped and not self._queue:
+                    return
+                batch, self._queue = self._queue, []
+                self._wake.clear()
+            if not batch:
+                continue
+            try:
+                groups = self._group(batch)
+            except Exception as e:
+                # grouping must never kill the dispatcher: fail THIS
+                # batch loudly and stay alive for future submits (a dead
+                # dispatcher would hang every later caller forever)
+                for p in batch:
+                    p.error = e
+                    p.done.set()
+                continue
+            for group in groups:
+                self._run_group(group)
+
+    def _group(self, batch: list[_Pending]) -> list[list[_Pending]]:
+        groups: dict[str, list[_Pending]] = {}
+        order: list[list[_Pending]] = []
+        rows: dict[str, int] = {}
+        for p in batch:
+            key = _compat_key(p.req)
+            if key in groups and rows[key] + p.rows <= self.max_rows:
+                groups[key].append(p)
+                rows[key] += p.rows
+            else:
+                g = [p]
+                groups[key] = g  # later arrivals join the newest group
+                rows[key] = p.rows
+                order.append(g)
+        return order
+
+    def _run_group(self, group: list[_Pending]) -> None:
+        if len(group) == 1:
+            p = group[0]
+            try:
+                p.results = self.engine._search_direct(p.req)
+            except Exception as e:
+                p.error = e
+            finally:
+                p.done.set()
+            return
+
+        from vearch_tpu.engine.engine import RequestKilled, SearchRequest
+
+        self.batches += 1
+        self.batched_requests += len(group)
+        try:
+            head = group[0].req
+            stacked = {
+                name: np.concatenate(
+                    [np.atleast_2d(np.asarray(p.req.vectors[name]))
+                     for p in group], axis=0,
+                )
+                for name in head.vectors
+            }
+            k = max(p.req.k for p in group)
+            trace: dict[str, Any] | None = (
+                {} if any(p.req.trace is not None for p in group) else None
+            )
+            big = SearchRequest(
+                vectors=stacked, k=k, filters=None,
+                include_fields=head.include_fields,
+                brute_force=False,
+                field_weights=head.field_weights,
+                index_params=head.index_params,
+                trace=trace,
+            )
+            results = self.engine._search_direct(big)
+        except Exception as e:
+            for p in group:
+                p.error = e
+                p.done.set()
+            return
+        off = 0
+        for p in group:
+            sub = results[off : off + p.rows]
+            off += p.rows
+            if p.req.ctx is not None and p.req.ctx.killed:
+                # best-effort kill: the shared dispatch already ran, but
+                # the killed caller still gets its abort
+                p.error = RequestKilled(p.req.ctx.reason or "request killed")
+                p.done.set()
+                continue
+            if p.req.k < k:
+                for r in sub:
+                    r.items = r.items[: p.req.k]
+            if p.req.trace is not None and trace is not None:
+                p.req.trace.update(trace)
+                p.req.trace["micro_batch_rows"] = sum(
+                    g.rows for g in group
+                )
+            p.results = sub
+            p.done.set()
