@@ -1,0 +1,181 @@
+"""Watch-driven router caches + faulty-node tracking (reference:
+internal/client/master_cache.go — etcd watch streams keep the client's
+space/server caches fresh; a faulty-server list routes reads around
+nodes whose RPCs just failed)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _mk_space(cl, name, replica_num=1, dim=D):
+    cl.create_space("db", {
+        "name": name, "partition_num": 2, "replica_num": replica_num,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": dim,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+
+
+def test_watch_longpoll_fires_on_mutation(cluster):
+    """GET /watch blocks, then returns within the poll window once a
+    metadata key changes — not after a TTL."""
+    rev0 = rpc.call(cluster.master_addr, "GET", "/watch",
+                    {"rev": 0, "timeout": 0.0})["rev"]
+    out = {}
+
+    def poll():
+        out["res"] = rpc.call(cluster.master_addr, "GET", "/watch",
+                              {"rev": rev0, "timeout": 10.0})
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.3)
+    cl = VearchClient(cluster.router_addr)
+    t0 = time.time()
+    cl.create_database("db")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert out["res"]["rev"] > rev0
+    assert any(k.startswith("/db/") for k in out["res"]["keys"]), out
+    assert time.time() - t0 < 3.0  # long-poll, not TTL wait
+
+
+def test_watch_reset_when_past_ring(cluster):
+    """A watcher older than the event ring is told to resync."""
+    master = cluster.master
+    for i in range(600):  # overflow the 512-event ring
+        master.store.put(f"/config/junk/{i % 7}", {"i": i})
+    out = rpc.call(cluster.master_addr, "GET", "/watch",
+                   {"rev": 1, "timeout": 0.0})
+    assert out.get("reset") is True
+
+
+def test_router_cache_invalidated_by_watch_not_ttl(cluster):
+    """With the TTL effectively infinite, a space drop+recreate is
+    visible to the router via the watch within one poll round."""
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    _mk_space(cl, "s", dim=D)
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((50, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(50)])
+    assert cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
+                     limit=1)[0][0]["_id"] == "d3"
+
+    cluster.router.space_cache_ttl = 1e9  # TTL can no longer help
+
+    # recreate with a different dimension: stale partition routing or a
+    # stale schema would fail the new-dim search
+    cl.drop_space("db", "s")
+    _mk_space(cl, "s", dim=D * 2)
+    big = rng.standard_normal((10, D * 2)).astype(np.float32)
+    deadline = time.time() + 10.0
+    last = None
+    while time.time() < deadline:
+        try:
+            cl.upsert("db", "s", [{"_id": f"n{i}", "v": big[i]}
+                                  for i in range(10)])
+            res = cl.search("db", "s",
+                            [{"field": "v", "feature": big[4]}], limit=1)
+            assert res[0][0]["_id"] == "n4"
+            break
+        except rpc.RpcError as e:  # watch not applied yet
+            last = e
+            time.sleep(0.2)
+    else:
+        raise AssertionError(f"watch never refreshed the cache: {last}")
+    stats = rpc.call(cluster.router_addr, "GET", "/router/stats", None)
+    assert stats["watch_rev"] > 0
+
+
+def test_faulty_node_skipped_by_read_balancing(cluster):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    _mk_space(cl, "r", replica_num=2)
+    rng = np.random.default_rng(1)
+    vecs = rng.standard_normal((40, D)).astype(np.float32)
+    cl.upsert("db", "r", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(40)])
+
+    victim = cluster.ps_nodes[1]
+    victim_id = victim.node_id
+    victim.stop()
+    # every read succeeds despite the dead replica; the first failure
+    # marks it faulty and later reads route around it
+    for i in range(6):
+        res = cl.search("db", "r",
+                        [{"field": "v", "feature": vecs[i]}],
+                        limit=1, load_balance="random")
+        assert res[0][0]["_id"] == f"d{i}"
+    stats = rpc.call(cluster.router_addr, "GET", "/router/stats", None)
+    faulty = stats["faulty_nodes"]
+    # the dead node was either penalised (observed at least once) or the
+    # random picks all landed on the healthy replica — force contact:
+    router = cluster.router
+    assert victim_id in router._faulty or all(
+        n != str(victim_id) for n in faulty
+    )
+    # deterministic check at the unit level: mark + skip
+    router._faulty[victim_id] = time.time() + 5.0
+    space = router._space("db", "r")
+    for p in space.partitions:
+        if victim_id in p.replicas and len(p.replicas) > 1:
+            for _ in range(10):
+                node, _addr = router._partition_target(space, p.id,
+                                                      "random")
+                assert node != victim_id
+
+
+def test_alias_resolved_cache_evicted_on_space_change(cluster):
+    """Space-cache entries created through an alias are evicted when the
+    CANONICAL space changes (watch keys name the real space, not the
+    alias the router cached under)."""
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    _mk_space(cl, "real", dim=D)
+    rpc.call(cluster.router_addr, "POST", "/alias/a1/dbs/db/spaces/real",
+             None)
+    rng = np.random.default_rng(2)
+    vecs = rng.standard_normal((20, D)).astype(np.float32)
+    cl.upsert("db", "real", [{"_id": f"d{i}", "v": vecs[i]}
+                             for i in range(20)])
+    # warm the cache through the alias
+    assert cl.search("db", "a1", [{"field": "v", "feature": vecs[1]}],
+                     limit=1)[0][0]["_id"] == "d1"
+    cluster.router.space_cache_ttl = 1e9
+
+    cl.drop_space("db", "real")
+    _mk_space(cl, "real", dim=D * 2)
+    rpc.call(cluster.router_addr, "POST", "/alias/a1/dbs/db/spaces/real",
+             None)
+    big = rng.standard_normal((6, D * 2)).astype(np.float32)
+    deadline = time.time() + 10.0
+    while True:
+        try:
+            cl.upsert("db", "real", [{"_id": f"n{i}", "v": big[i]}
+                                     for i in range(6)])
+            res = cl.search("db", "a1",
+                            [{"field": "v", "feature": big[3]}], limit=1)
+            assert res[0][0]["_id"] == "n3"
+            break
+        except rpc.RpcError:
+            assert time.time() < deadline, "alias cache never refreshed"
+            time.sleep(0.2)

@@ -50,15 +50,9 @@ def main(argv: list[str] | None = None) -> int:
         args.auth = args.auth or cfg.auth
         args.root_password = cfg.root_password
         # per-role rotating file log + stderr (reference: [global] log
-        # dir + level, pkg/log rotating writer). An explicit [global]
-        # log wins; otherwise logs follow the EFFECTIVE data dir, which
-        # --data-dir may have overridden past the TOML value
-        import os
-
-        log_dir = cfg.global_.get("log") or os.path.join(
-            args.data_dir, "logs"
-        )
-        log.init(args.role, log_dir=str(log_dir), level=cfg.log_level)
+        # dir + level, pkg/log rotating writer)
+        log.init(args.role, log_dir=cfg.log_dir_for(args.data_dir),
+                 level=cfg.log_level)
     else:
         import os
 

@@ -79,11 +79,16 @@ class Config:
 
     @property
     def log_dir(self) -> str:
+        return self.log_dir_for(self.data_dir)
+
+    def log_dir_for(self, data_dir: str) -> str:
+        """Log directory given the EFFECTIVE data dir (a --data-dir CLI
+        override may differ from the TOML value): explicit [global] log
+        wins, otherwise logs live under the data dir."""
         import os
 
-        return str(self.global_.get(
-            "log", os.path.join(self.data_dir, "logs")
-        ))
+        explicit = self.global_.get("log")
+        return str(explicit) if explicit else os.path.join(data_dir, "logs")
 
     @property
     def auth(self) -> bool:

@@ -128,6 +128,7 @@ class MasterServer:
         s.route("GET", "/", self._h_cluster_info)
         s.route("POST", "/register", self._h_register)
         s.route("GET", "/servers", self._h_servers)
+        s.route("GET", "/watch", self._h_watch)
         s.route("POST", "/dbs", self._h_create_db)  # POST /dbs/{db}
         s.route("GET", "/dbs", self._h_get_db)
         s.route("DELETE", "/dbs", self._h_delete_db)
@@ -140,6 +141,25 @@ class MasterServer:
         s.route("POST", "/alias", self._h_create_alias)
         s.route("GET", "/alias", self._h_get_alias)
         s.route("DELETE", "/alias", self._h_delete_alias)
+
+        # -- watch hub (reference: etcd watch streams that the client
+        # caches in master_cache.go:414 hang off). Every store mutation
+        # bumps a revision and records its key in a small ring; routers
+        # long-poll GET /watch?rev=N and invalidate caches the moment
+        # metadata changes instead of waiting out a TTL. Watches fire on
+        # every master replica in log order, so any master serves them.
+        self._watch_rev = 0
+        self._watch_ring: list[tuple[int, str]] = []  # (rev, key)
+        self._watch_cond = threading.Condition()
+
+        def _on_meta_change(event: str, key: str, _value) -> None:
+            with self._watch_cond:
+                self._watch_rev += 1
+                self._watch_ring.append((self._watch_rev, key))
+                del self._watch_ring[:-512]
+                self._watch_cond.notify_all()
+
+        self.store.watch_prefix("", _on_meta_change)
 
         if self.replicated:
             self._setup_meta_raft()
@@ -288,6 +308,36 @@ class MasterServer:
                 _log.warning("master %s: promotion work retrying: %s",
                              self.node_id, str(e)[:60])
                 time.sleep(0.3)
+
+    def _h_watch(self, body, _parts) -> dict:
+        """Long-poll watch (reference: etcd Watch streams): blocks until
+        the metadata revision passes the caller's `rev` or `timeout`
+        elapses; returns the new revision plus the changed keys since
+        `rev` (empty on timeout, `reset` when the caller is older than
+        the 512-event ring — resync by full cache invalidation)."""
+        body = body or {}
+        rev = int(body.get("rev", 0))
+        timeout = min(float(body.get("timeout", 25.0)), 55.0)
+        deadline = time.time() + timeout
+        with self._watch_cond:
+            while self._watch_rev <= rev and not self._stop.is_set():
+                remain = deadline - time.time()
+                if remain <= 0:
+                    break
+                self._watch_cond.wait(min(remain, 1.0))
+            cur = self._watch_rev
+            ring = list(self._watch_ring)
+        if cur <= rev:
+            return {"rev": cur, "keys": []}
+        oldest = ring[0][0] if ring else cur + 1
+        if rev + 1 < oldest:
+            # the caller missed events beyond the ring: tell it to drop
+            # everything rather than serve a partial delta as complete
+            return {"rev": cur, "reset": True, "keys": []}
+        return {
+            "rev": cur,
+            "keys": sorted({k for r, k in ring if r > rev}),
+        }
 
     def start(self) -> None:
         self.server.start()

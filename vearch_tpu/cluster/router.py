@@ -49,6 +49,19 @@ class RouterServer:
         self._auth_cache: dict[tuple[str, str], tuple[float, dict]] = {}
         self._cache_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=32)
+        # TTL is the fallback freshness bound; the watch loop below
+        # usually invalidates within one long-poll round trip
+        self.space_cache_ttl = SPACE_CACHE_TTL
+        # faulty-node tracking (reference: client/master_cache.go
+        # faulty-server list): a node whose RPC just failed is skipped
+        # by read load-balancing until its penalty expires, instead of
+        # every request re-discovering the failure via timeout
+        self._faulty: dict[int, float] = {}  # node_id -> penalty expiry
+        self.faulty_ttl = 5.0
+        # canonical "db/space" -> alias cache keys resolved through it
+        self._alias_backmap: dict[str, set[str]] = {}
+        self._watch_rev = 0
+        self._watch_stop = threading.Event()
 
         self.server = JsonRpcServer(
             host, port,
@@ -71,14 +84,89 @@ class RouterServer:
         s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
         s.route("POST", "/partitions/rule", self._h_partition_rule)
         s.route("GET", "/cluster/health", self._h_health)
+        s.route("GET", "/router/stats", self._h_router_stats)
         s.tracer = self.tracer  # serves GET /debug/traces
 
     def start(self) -> None:
         self.server.start()
+        threading.Thread(target=self._watch_loop, daemon=True,
+                         name="router-watch").start()
 
     def stop(self) -> None:
+        self._watch_stop.set()
         self.server.stop()
         self._pool.shutdown(wait=False)
+
+    # -- watch-driven cache invalidation (reference: master_cache.go:414
+    #    etcd watch streams keeping client caches fresh) ---------------------
+
+    def _watch_loop(self) -> None:
+        while not self._watch_stop.is_set():
+            try:
+                out = self._master_call("GET", "/watch", {
+                    "rev": self._watch_rev, "timeout": 20.0,
+                })
+            except RpcError:
+                # master unreachable/failing over: TTL expiry covers
+                # freshness until the watch reconnects
+                self._watch_stop.wait(1.0)
+                continue
+            new_rev = int(out.get("rev", self._watch_rev))
+            if new_rev < self._watch_rev:
+                # revision went BACKWARDS: watch revs are per-master
+                # process counters, so a failover/restart restarts the
+                # numbering — any delta we think we have is meaningless.
+                # Resync by dropping everything.
+                self._watch_rev = new_rev
+                self._invalidate_caches()
+                continue
+            self._watch_rev = new_rev
+            if out.get("reset"):
+                self._invalidate_caches()
+                continue
+            self._apply_watch_keys(out.get("keys") or [])
+
+    def _apply_watch_keys(self, keys: list[str]) -> None:
+        """Selective invalidation by changed key prefix."""
+        spaces: set[str] = set()
+        servers = False
+        everything = False
+        for key in keys:
+            if key.startswith("/space/"):
+                spaces.add(key[len("/space/"):])  # "db/name"
+            elif key.startswith(("/server/", "/fail_server/")):
+                servers = True
+            elif key.startswith(("/db/", "/alias/")):
+                # db drop / alias retarget change space resolution in
+                # ways a space-key diff does not capture
+                everything = True
+        with self._cache_lock:
+            if everything:
+                self._space_cache.clear()
+                self._server_cache = (0.0, {})
+                return
+            for sk in spaces:
+                self._space_cache.pop(sk, None)
+                # alias-resolved entries cache under the ALIAS key but
+                # watch events name the canonical space — evict through
+                # the back-map or alias users would stay stale
+                for alias_key in self._alias_backmap.pop(sk, ()):
+                    self._space_cache.pop(alias_key, None)
+            if servers:
+                self._server_cache = (0.0, {})
+
+    def _h_router_stats(self, _body, _parts) -> dict:
+        now = time.time()
+        with self._cache_lock:
+            return {
+                "watch_rev": self._watch_rev,
+                "faulty_nodes": {
+                    str(n): round(t - now, 2)
+                    for n, t in self._faulty.items() if t > now
+                },
+                "space_cache": len(self._space_cache),
+                "server_cache": len(self._server_cache[1]),
+            }
 
     @property
     def addr(self) -> str:
@@ -92,8 +180,9 @@ class RouterServer:
         now = time.time()
         with self._cache_lock:
             hit = self._space_cache.get(key)
-            if hit and now - hit[0] < SPACE_CACHE_TTL:
+            if hit and now - hit[0] < self.space_cache_ttl:
                 return hit[1]
+        canonical = key
         try:
             data = self._master_call("GET", f"/dbs/{db}/spaces/{name}")
         except RpcError as e:
@@ -105,16 +194,19 @@ class RouterServer:
                 "GET",
                 f"/dbs/{alias['db_name']}/spaces/{alias['space_name']}",
             )
+            canonical = f"{alias['db_name']}/{alias['space_name']}"
         space = Space.from_dict(data)
         with self._cache_lock:
             self._space_cache[key] = (now, space)
+            if canonical != key:
+                self._alias_backmap.setdefault(canonical, set()).add(key)
         return space
 
     def _servers(self) -> dict[int, Server]:
         now = time.time()
         with self._cache_lock:
             ts, cache = self._server_cache
-            if now - ts < SPACE_CACHE_TTL and cache:
+            if now - ts < self.space_cache_ttl and cache:
                 return cache
         data = self._master_call("GET", "/servers")
         servers = {
@@ -124,30 +216,37 @@ class RouterServer:
             self._server_cache = (now, servers)
         return servers
 
-    def _partition_addr(
+    def _partition_target(
         self, space: Space, partition_id: int, load_balance: str = "leader"
-    ) -> str:
+    ) -> tuple[int, str]:
         """Pick a replica for the RPC (reference: client/ps.go:33-39
         clientType LEADER/NOTLEADER/RANDOM). Writes always go to the
         leader; reads may spread across replicas (replication is
-        synchronous, so followers serve the same committed state)."""
+        synchronous, so followers serve the same committed state).
+        Read balancing skips nodes under a faulty penalty; the leader is
+        never skipped for leader-targeted calls — correctness over
+        availability there, and the failover retry handles a dead one."""
         import random
 
         servers = self._servers()
+        now = time.time()
         part = next(p for p in space.partitions if p.id == partition_id)
         leader = part.leader if part.leader >= 0 else part.replicas[0]
         candidates = [r for r in part.replicas if r in servers]
+        healthy = [r for r in candidates
+                   if self._faulty.get(r, 0.0) <= now]
         node = leader
         if load_balance == "random" and candidates:
-            node = random.choice(candidates)
+            node = random.choice(healthy or candidates)
         elif load_balance == "not_leader":
-            followers = [r for r in candidates if r != leader]
+            followers = [r for r in (healthy or candidates) if r != leader]
             if followers:
                 node = random.choice(followers)
         srv = servers.get(node) or servers.get(leader)
         if srv is None:
             raise RpcError(503, f"no server for partition {partition_id}")
-        return srv.rpc_addr
+        return (node if servers.get(node) is not None else leader,
+                srv.rpc_addr)
 
     def _invalidate_caches(self) -> None:
         with self._cache_lock:
@@ -170,13 +269,33 @@ class RouterServer:
             if attempt:
                 self._invalidate_caches()
                 time.sleep(0.3 * attempt)
+            node = -1
             try:
                 space = self._space(*space_key)
-                lb = load_balance if attempt == 0 else "leader"
-                return rpc.call(
-                    self._partition_addr(space, pid, lb), "POST", path,
-                    {**body, "partition_id": pid})
+                lb = load_balance
+                if attempt and (
+                    load_balance == "leader"
+                    or last is None or last.code != -1
+                ):
+                    # 421/503 mean the leadership map moved: re-aim at
+                    # the (refreshed) leader. A plain unreachable node
+                    # on a READ keeps the caller's balancing — the
+                    # faulty penalty steers the next pick to a healthy
+                    # replica instead of forcing reads onto a possibly
+                    # dead leader mid-failover
+                    lb = "leader"
+                node, addr = self._partition_target(space, pid, lb)
+                out = rpc.call(addr, "POST", path,
+                               {**body, "partition_id": pid})
+                with self._cache_lock:
+                    self._faulty.pop(node, None)  # proven healthy
+                return out
             except RpcError as e:
+                if e.code == -1 and node >= 0:
+                    # unreachable: penalise so read balancing routes
+                    # around it instead of rediscovering per request
+                    with self._cache_lock:
+                        self._faulty[node] = time.time() + self.faulty_ttl
                 if e.code not in (-1, 421, 503):
                     raise
                 last = e
