@@ -218,3 +218,128 @@ def test_blockmax_never_resurrects_filtered_docs():
         jnp.asarray(np.ones(1024, np.float32)), jnp.asarray(vsq[:1024]),
         jnp.asarray(np.ones(1024, bool)), 128, MetricType.L2, "blockmax")
     assert np.asarray(s).shape[0] == 4
+
+
+class TestHnswCoarseQuantizer:
+    """quantizer_type=hnsw (reference: gamma_index_ivfpq.h:1258-1329
+    quantizer_type_ — HNSW over the centroids replaces the flat coarse
+    scan; here the graph runs on HOST so probe selection costs no
+    device dispatch)."""
+
+    def _data(self, n=20_000, d=32):
+        rng = np.random.default_rng(17)
+        centers = (rng.standard_normal((150, d)) * 3).astype(np.float32)
+        base = centers[rng.integers(0, 150, n)] + \
+            0.6 * rng.standard_normal((n, d)).astype(np.float32)
+        return base
+
+    def _engine(self, base, extra=None):
+        from vearch_tpu.engine.engine import Engine
+
+        schema = TableSchema("hq", [
+            FieldSchema("v", DataType.VECTOR, dimension=base.shape[1],
+                        index=IndexParams("IVFPQ", MetricType.L2, {
+                            "ncentroids": 128, "nsubvector": 8,
+                            "train_iters": 5, "training_threshold":
+                            base.shape[0], "scan_mode": "probe",
+                            "nprobe": 24, "quantizer_type": "hnsw",
+                            **(extra or {}),
+                        })),
+        ])
+        eng = Engine(schema)
+        n = base.shape[0]
+        for i in range(0, n, 10_000):
+            eng.upsert([{"_id": str(j), "v": base[j]}
+                        for j in range(i, min(i + 10_000, n))])
+        eng.build_index()
+        return eng
+
+    def test_probe_recall_matches_flat_quantizer(self):
+        import pytest
+
+        from vearch_tpu.engine.engine import SearchRequest
+        from vearch_tpu.native.hnsw_graph import HnswGraph, _load
+
+        if _load() is None:
+            pytest.skip("no native toolchain")
+        base = self._data()
+        eng = self._engine(base)
+        idx = eng.indexes["v"]
+        assert idx.quantizer_type == "hnsw"
+        assert idx._coarse_graph is not None
+
+        rng = np.random.default_rng(5)
+        q = base[:48] + 0.05 * rng.standard_normal(
+            (48, base.shape[1])).astype(np.float32)
+        exact = np.argsort(
+            ((q[:, None, :].astype(np.float64)
+              - base[None, :, :].astype(np.float64)) ** 2).sum(-1),
+            axis=1)[:, :10]
+        res = eng.search(SearchRequest(vectors={"v": q}, k=10,
+                                       include_fields=[],
+                                       index_params={"rerank": 256}))
+        got = [[int(it.key) for it in r.items] for r in res]
+        r10 = float(np.mean([
+            len(set(got[i]) & set(exact[i].tolist())) / 10
+            for i in range(48)
+        ]))
+        assert r10 >= 0.8, r10
+
+    def test_hnsw_assignment_close_to_exact(self):
+        import pytest
+
+        from vearch_tpu.native.hnsw_graph import _load
+        from vearch_tpu.ops import kmeans as km
+
+        if _load() is None:
+            pytest.skip("no native toolchain")
+        base = self._data(n=8000)
+        eng = self._engine(base)
+        idx = eng.indexes["v"]
+        rows = base[:2000]
+        import jax.numpy as jnp
+
+        exact = np.asarray(km.assign_clusters(jnp.asarray(rows),
+                                              idx.centroids))
+        graph = idx._assign(rows)
+        agreement = float(np.mean(exact == graph))
+        assert agreement >= 0.95, agreement
+
+    def test_dump_load_rebuilds_graph(self, tmp_path):
+        import pytest
+
+        from vearch_tpu.engine.engine import Engine, SearchRequest
+        from vearch_tpu.native.hnsw_graph import _load
+
+        if _load() is None:
+            pytest.skip("no native toolchain")
+        base = self._data(n=8000)
+        eng = self._engine(base)
+        eng.dump(str(tmp_path))
+        eng2 = Engine.open(str(tmp_path))
+        idx2 = eng2.indexes["v"]
+        assert idx2._coarse_graph is not None
+        res = eng2.search(SearchRequest(vectors={"v": base[7]}, k=3,
+                                        include_fields=[]))
+        assert res[0].items[0].key == "7"
+
+    def test_fallback_to_flat_without_native(self, monkeypatch):
+        """The PRODUCTION except-branch runs: HnswGraph construction
+        raising RuntimeError (no toolchain) must degrade to the flat
+        quantizer, not crash training."""
+        import vearch_tpu.native.hnsw_graph as hg
+
+        class Unavailable:
+            def __init__(self, *a, **kw):
+                raise RuntimeError("native HNSW unavailable (forced)")
+
+        monkeypatch.setattr(hg, "HnswGraph", Unavailable)
+        base = self._data(n=6000)
+        eng = self._engine(base)
+        idx = eng.indexes["v"]
+        assert idx.quantizer_type == "flat"
+        from vearch_tpu.engine.engine import SearchRequest
+
+        res = eng.search(SearchRequest(vectors={"v": base[3]}, k=3,
+                                       include_fields=[]))
+        assert res[0].items[0].key == "3"

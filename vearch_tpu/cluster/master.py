@@ -318,6 +318,13 @@ class MasterServer:
         body = body or {}
         rev = int(body.get("rev", 0))
         timeout = min(float(body.get("timeout", 25.0)), 55.0)
+        with self._watch_cond:
+            if rev > self._watch_rev:
+                # the caller is AHEAD of this process (master restarted
+                # or failed over — revs are per-process): make it resync
+                # now, not after a full idle poll window during which
+                # invalidations would be silently lost
+                return {"rev": self._watch_rev, "reset": True, "keys": []}
         deadline = time.time() + timeout
         with self._watch_cond:
             while self._watch_rev <= rev and not self._stop.is_set():
@@ -654,10 +661,20 @@ class MasterServer:
             labels=body.get("labels") or {},
         )
         lease = self._leases.get(node_id)
-        if lease is None or not self.store.keepalive(lease, self.heartbeat_ttl):
+        refreshed = (
+            lease is not None
+            and self.store.keepalive(lease, self.heartbeat_ttl)
+        )
+        if not refreshed:
             lease = self.store.grant_lease(self.heartbeat_ttl)
             self._leases[node_id] = lease
-        self.store.put(key, server.to_dict(), lease=lease)
+        record = server.to_dict()
+        if not refreshed or existing != record:
+            # only write when something changed (or a fresh lease needs
+            # binding): an unconditional put would fire a /server/ watch
+            # event per 2s heartbeat, making every router clear its
+            # server cache continuously and long-polls never idle
+            self.store.put(key, record, lease=lease)
         if self.store.get(f"/fail_server/{node_id}") is not None:
             # guarded: an unconditional delete would cost a quorum
             # proposal on every heartbeat in replicated mode

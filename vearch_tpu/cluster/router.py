@@ -183,6 +183,7 @@ class RouterServer:
             if hit and now - hit[0] < self.space_cache_ttl:
                 return hit[1]
         canonical = key
+        rev0 = self._watch_rev  # taken BEFORE the master fetch
         try:
             data = self._master_call("GET", f"/dbs/{db}/spaces/{name}")
         except RpcError as e:
@@ -197,9 +198,16 @@ class RouterServer:
             canonical = f"{alias['db_name']}/{alias['space_name']}"
         space = Space.from_dict(data)
         with self._cache_lock:
-            self._space_cache[key] = (now, space)
-            if canonical != key:
-                self._alias_backmap.setdefault(canonical, set()).add(key)
+            # a watch event between our fetch and now may have evicted
+            # this very key — caching what we fetched would write STALE
+            # metadata back after its invalidation was consumed. Serve
+            # the fetched value but don't cache it; the next call
+            # re-fetches fresh.
+            if self._watch_rev == rev0:
+                self._space_cache[key] = (now, space)
+                if canonical != key:
+                    self._alias_backmap.setdefault(canonical,
+                                                   set()).add(key)
         return space
 
     def _servers(self) -> dict[int, Server]:
@@ -242,11 +250,10 @@ class RouterServer:
             followers = [r for r in (healthy or candidates) if r != leader]
             if followers:
                 node = random.choice(followers)
-        srv = servers.get(node) or servers.get(leader)
+        srv = servers.get(node)
         if srv is None:
             raise RpcError(503, f"no server for partition {partition_id}")
-        return (node if servers.get(node) is not None else leader,
-                srv.rpc_addr)
+        return node, srv.rpc_addr
 
     def _invalidate_caches(self) -> None:
         with self._cache_lock:

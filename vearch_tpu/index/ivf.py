@@ -49,6 +49,16 @@ class _IVFBase(VectorIndex):
         self.default_nprobe = int(params.get("nprobe", 16))
         self.train_sample = int(params.get("training_sample", 262_144))
         self.train_iters = int(params.get("train_iters", 10))
+        # coarse quantizer choice (reference: gamma_index_ivfpq.h:1258
+        # quantizer_type_ — FLAT vs HNSW over the centroids). On TPU the
+        # [B, nlist] matmul is usually the right answer; the HNSW graph
+        # wins when probe selection should stay on HOST — tiny batches
+        # or huge nlist, where a device dispatch per coarse step costs
+        # more than an O(log nlist) graph walk.
+        self.quantizer_type = str(
+            params.get("quantizer_type", "flat")
+        ).lower()
+        self._coarse_graph = None
         self.centroids: jax.Array | None = None  # [nlist, d] f32
         self._members: list[list[int]] = []  # per-cluster docid lists (host)
         self._dirty = True
@@ -79,8 +89,50 @@ class _IVFBase(VectorIndex):
             jnp.asarray(x), k=self.nlist, iters=self.train_iters
         )
         self._members = [[] for _ in range(self.nlist)]
+        self._build_coarse_graph()
         self._train_extra(x)
         self.trained = True
+
+    def _build_coarse_graph(self) -> None:
+        if self.quantizer_type != "hnsw":
+            return
+        try:
+            from vearch_tpu.native.hnsw_graph import HnswGraph
+
+            g = HnswGraph(self.store.dimension, m=16, ef_construction=200,
+                          ip=False)
+            g.add(np.asarray(self.centroids, dtype=np.float32))
+            self._coarse_graph = g
+        except RuntimeError as e:
+            from vearch_tpu.utils import log
+
+            log.warn("hnsw coarse quantizer unavailable (%s); "
+                     "falling back to flat", e)
+            self.quantizer_type = "flat"
+            self._coarse_graph = None
+
+    def _assign(self, rows: np.ndarray) -> np.ndarray:
+        """Cluster assignment for absorb: device matmul (exact) or the
+        host HNSW graph walk (quantizer_type=hnsw — no device dispatch,
+        which matters when absorb runs on the cluster's write path)."""
+        if self._coarse_graph is not None:
+            _s, ids = self._coarse_graph.search(rows, 1, ef=96)
+            return ids[:, 0].astype(np.int64)
+        return np.asarray(
+            km.assign_clusters(jnp.asarray(rows), self.centroids)
+        )
+
+    def _host_probes(self, q: np.ndarray, nprobe: int) -> np.ndarray | None:
+        """[B, nprobe] probe cells from the host graph, or None for the
+        in-kernel matmul selection."""
+        if self._coarse_graph is None:
+            return None
+        _s, ids = self._coarse_graph.search(
+            q, min(nprobe, self.nlist), ef=max(2 * nprobe, 64)
+        )
+        # -1 padding (unreachable cells) would crash the gather: aim
+        # padded slots at cell 0 — scanning a cell twice is harmless
+        return np.ascontiguousarray(np.maximum(ids, 0), dtype=np.int32)
 
     def _train_extra(self, sample: np.ndarray) -> None:
         pass
@@ -98,9 +150,7 @@ class _IVFBase(VectorIndex):
             rows = self._maybe_normalize(
                 self.store.host_view()[start:upto].astype(np.float32)
             )
-            assign = np.asarray(
-                km.assign_clusters(jnp.asarray(rows), self.centroids)
-            )
+            assign = self._assign(rows)
             self._absorb_rows(rows, assign, start)
             # vectorised bucket grouping: argsort by cluster + split beats a
             # python append loop ~50x at 1M rows
@@ -176,6 +226,7 @@ class _IVFBase(VectorIndex):
     def load_state(self, state: dict[str, Any]) -> None:
         if "centroids" in state:
             self.centroids = jnp.asarray(state["centroids"])
+            self._build_coarse_graph()  # rebuilt, not persisted: cheap
             self.trained = True
             self._members = [[] for _ in range(self.nlist)]
             # re-absorb everything: assignments are recomputed, codes
@@ -237,6 +288,7 @@ class IVFFlatIndex(_IVFBase):
             else self.metric
         )
         valid = self._valid_device(valid_mask, self.store.count)
+        host_probes = self._host_probes(q, nprobe)
         scores, ids = ivf_ops.ivfflat_candidates(
             jnp.asarray(q, dtype=self.store.store_dtype),
             self.centroids,
@@ -247,6 +299,8 @@ class IVFFlatIndex(_IVFBase):
             nprobe,
             min(max(r, k), 2048),
             metric,
+            probes=None if host_probes is None
+            else jnp.asarray(host_probes),
         )
         scores, ids = jax.device_get((scores, ids))
         # IVFFLAT scores are already exact — no rerank needed; cosine
@@ -478,6 +532,11 @@ class IVFPQIndex(_IVFBase):
             kernel = (params or {}).get(
                 "probe_kernel", self.params.get("probe_kernel", default_kernel)
             )
+            host_probes = self._host_probes(q, nprobe)
+            if host_probes is not None:
+                # the pallas kernel selects probes in-kernel via scalar
+                # prefetch; host-graph selection rides the XLA path
+                kernel = "xla"
             if kernel == "pallas":
                 from vearch_tpu.ops.pallas_kernels import (
                     ivfpq_probe_search_pallas,
@@ -507,6 +566,8 @@ class IVFPQIndex(_IVFBase):
                     nprobe,
                     max(r, k),
                     metric,
+                    probes=None if host_probes is None
+                    else jnp.asarray(host_probes),
                 )
         from vearch_tpu.index._store_paths import rerank_against_store
 

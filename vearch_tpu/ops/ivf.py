@@ -77,12 +77,18 @@ def ivfflat_candidates(
     nprobe: int,
     r: int,
     metric: MetricType = MetricType.L2,
+    probes: jax.Array | None = None,  # [B, nprobe] i32 (precomputed)
 ) -> tuple[jax.Array, jax.Array]:
-    """Scan nprobe buckets per query; return top-r (scores, docids)."""
+    """Scan nprobe buckets per query; return top-r (scores, docids).
+
+    `probes` overrides the in-kernel matmul selection — the HNSW coarse
+    quantizer computes them on host (quantizer_type=hnsw)."""
     b = queries.shape[0]
-    probes = _coarse_probes(
-        queries.astype(jnp.float32), centroids, nprobe
-    )  # [B, nprobe]
+    if probes is None:
+        probes = _coarse_probes(
+            queries.astype(jnp.float32), centroids, nprobe
+        )  # [B, nprobe]
+    nprobe = int(probes.shape[1])
     q_sq = sqnorms(queries)  # [B]
 
     init = (
@@ -125,6 +131,7 @@ def ivfpq_candidates(
     nprobe: int,
     r: int,
     metric: MetricType = MetricType.L2,
+    probes: jax.Array | None = None,  # [B, nprobe] i32 (precomputed)
 ) -> tuple[jax.Array, jax.Array]:
     """MXU-native IVFPQ scan.
 
@@ -146,7 +153,9 @@ def ivfpq_candidates(
         IP score = q.v
     """
     b = queries.shape[0]
-    probes = _coarse_probes(queries, centroids, nprobe)  # [B, nprobe]
+    if probes is None:
+        probes = _coarse_probes(queries, centroids, nprobe)  # [B, nprobe]
+    nprobe = int(probes.shape[1])
     q_sq = sqnorms(queries)
     qb = queries.astype(jnp.bfloat16)
 
