@@ -343,3 +343,33 @@ class TestHnswCoarseQuantizer:
         res = eng.search(SearchRequest(vectors={"v": base[3]}, k=3,
                                        include_fields=[]))
         assert res[0].items[0].key == "3"
+
+
+def test_padded_probe_slots_never_duplicate_results():
+    """A probes row containing -1 padding must not scan a real cell
+    twice: no docid may appear more than once in the top-k."""
+    import jax.numpy as jnp
+
+    from vearch_tpu.ops import ivf as ivf_ops
+
+    rng = np.random.default_rng(3)
+    nlist, cap, d = 4, 8, 16
+    cents = rng.standard_normal((nlist, d)).astype(np.float32)
+    vecs = rng.standard_normal((nlist, cap, d)).astype(np.float32)
+    ids = np.arange(nlist * cap, dtype=np.int32).reshape(nlist, cap)
+    sqn = (vecs ** 2).sum(-1).astype(np.float32)
+    valid = np.ones(nlist * cap, dtype=bool)
+    q = rng.standard_normal((3, d)).astype(np.float32)
+    # every row probes cell 2 once plus two padded slots
+    probes = np.array([[2, -1, -1]] * 3, dtype=np.int32)
+    scores, out = ivf_ops.ivfflat_candidates(
+        jnp.asarray(q), jnp.asarray(cents), jnp.asarray(vecs),
+        jnp.asarray(sqn), jnp.asarray(ids), jnp.asarray(valid),
+        3, 16, MetricType.L2, probes=jnp.asarray(probes),
+    )
+    out = np.asarray(out)
+    for row in out:
+        real = row[row >= 0]
+        assert len(real) == len(set(real.tolist())), row
+        # only cell 2's docids can appear
+        assert all(16 <= i < 24 for i in real), row

@@ -173,3 +173,16 @@ def test_ivfpq_data_parallel_matches_single_device(rng):
     e8.delete([r8[0].items[0].key])
     r8b = e8.search(req())
     assert r8b[0].items[0].key == r8[0].items[1].key
+
+
+def test_mesh_callables_are_cached():
+    """Repeated mesh searches must reuse one jitted program (re-creating
+    the shard_map closure per call would retrace every search)."""
+    from vearch_tpu.parallel import sharded
+
+    before = sharded._flat_search_fn.cache_info().currsize
+    mesh = mesh_lib.default_mesh()
+    f1 = sharded._flat_search_fn(mesh, 5, MetricType.L2)
+    f2 = sharded._flat_search_fn(mesh, 5, MetricType.L2)
+    assert f1 is f2
+    assert sharded._flat_search_fn.cache_info().currsize <= before + 1

@@ -130,9 +130,10 @@ class _IVFBase(VectorIndex):
         _s, ids = self._coarse_graph.search(
             q, min(nprobe, self.nlist), ef=max(2 * nprobe, 64)
         )
-        # -1 padding (unreachable cells) would crash the gather: aim
-        # padded slots at cell 0 — scanning a cell twice is harmless
-        return np.ascontiguousarray(np.maximum(ids, 0), dtype=np.int32)
+        # -1 padding (graph came up short) passes through: the scan
+        # kernels mask those probe steps entirely — clamping to a real
+        # cell here would scan it twice and DUPLICATE its docids
+        return np.ascontiguousarray(ids, dtype=np.int32)
 
     def _train_extra(self, sample: np.ndarray) -> None:
         pass

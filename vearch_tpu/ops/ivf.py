@@ -98,6 +98,11 @@ def ivfflat_candidates(
 
     def step(best, pr):
         c = probes[:, pr]  # [B]
+        # c == -1 marks a padded probe slot (host HNSW selection came up
+        # short): scan cell 0 for shape but mask every hit — scanning a
+        # real cell twice would DUPLICATE its docids in the top-k
+        cell_ok = c >= 0
+        c = jnp.maximum(c, 0)
         vecs = bucket_vecs[c]  # [B, cap, d]
         ids = bucket_ids[c]  # [B, cap]
         vsq = bucket_sqnorm[c]  # [B, cap]
@@ -110,7 +115,7 @@ def ivfflat_candidates(
             scores = -(q_sq[:, None] - 2.0 * dots + vsq)
         else:
             scores = dots
-        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)]
+        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)] & cell_ok[:, None]
         scores = jnp.where(ok, scores, NEG_INF)
         return _fold_topk(best, scores, ids), None
 
@@ -166,6 +171,10 @@ def ivfpq_candidates(
 
     def step(best, pr):
         c = probes[:, pr]  # [B]
+        # padded probe slots (c == -1) scan cell 0 fully masked — see
+        # the ivfflat step for why duplicates would otherwise leak
+        cell_ok = c >= 0
+        c = jnp.maximum(c, 0)
         cent = centroids[c]  # [B, d] f32
         resid8 = bucket_resid8[c]  # [B, cap, d] int8
         ids = bucket_ids[c]  # [B, cap]
@@ -180,7 +189,7 @@ def ivfpq_candidates(
             scores = -(q_sq[:, None] - 2.0 * dots + vsq)
         else:
             scores = dots
-        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)]
+        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)] & cell_ok[:, None]
         scores = jnp.where(ok, scores, NEG_INF)
         return _fold_topk(best, scores, ids), None
 

@@ -29,18 +29,13 @@ from vearch_tpu.parallel import mesh as mesh_lib
 NEG_INF = float("-inf")
 
 
-def sharded_flat_search(
-    mesh: Mesh,
-    base: jax.Array,      # [N_pad, d] sharded P("data", None)
-    base_sqnorm: jax.Array,  # [N_pad] sharded P("data")
-    valid: jax.Array,     # [N_pad] bool sharded P("data")
-    queries: jax.Array,   # [B_pad, d] sharded P("query", None)
-    k: int,
-    metric: MetricType = MetricType.L2,
-) -> tuple[jax.Array, jax.Array]:
-    """Exact search over a row-sharded base: local top-k per shard, then
-    all_gather over "data" + global re-top-k, all on device."""
+@functools.lru_cache(maxsize=128)
+def _flat_search_fn(mesh: Mesh, k: int, metric: MetricType):
+    """Build-once jitted shard_map program. Re-creating the closure per
+    call would retrace every search: jit's cache keys on function
+    identity, so the callable itself is cached per (mesh, statics)."""
 
+    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -59,7 +54,23 @@ def sharded_flat_search(
         top_s, pos = jax.lax.top_k(all_s, kk)
         return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
-    return run(base, base_sqnorm, valid, queries)
+    return run
+
+
+def sharded_flat_search(
+    mesh: Mesh,
+    base: jax.Array,      # [N_pad, d] sharded P("data", None)
+    base_sqnorm: jax.Array,  # [N_pad] sharded P("data")
+    valid: jax.Array,     # [N_pad] bool sharded P("data")
+    queries: jax.Array,   # [B_pad, d] sharded P("query", None)
+    k: int,
+    metric: MetricType = MetricType.L2,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact search over a row-sharded base: local top-k per shard, then
+    all_gather over "data" + global re-top-k, all on device."""
+    return _flat_search_fn(mesh, k, metric)(
+        base, base_sqnorm, valid, queries
+    )
 
 
 def sharded_int8_search(
@@ -74,8 +85,17 @@ def sharded_int8_search(
     topk_mode: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
     """Sharded compressed scan (the IVFPQ full-scan path across chips)."""
+    return _int8_search_fn(mesh, r, metric, topk_mode)(
+        approx8, row_scale, row_vsq, valid, queries
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def _int8_search_fn(mesh: Mesh, r: int, metric: MetricType,
+                    topk_mode: str):
     from vearch_tpu.ops.ivf import int8_scan_candidates
 
+    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -100,7 +120,7 @@ def sharded_int8_search(
         top_s, pos = jax.lax.top_k(all_s, rr)
         return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
-    return run(approx8, row_scale, row_vsq, valid, queries)
+    return run
 
 
 def sharded_exact_rerank(
@@ -116,7 +136,14 @@ def sharded_exact_rerank(
     scores the candidates it owns (others -inf), pmax over "data" merges
     without leaving the device, then one small top-k. The mesh analogue
     of ops/ivf.py exact_rerank."""
+    return _exact_rerank_fn(mesh, k, metric)(
+        queries, cand_ids, base, base_sqnorm
+    )
 
+
+@functools.lru_cache(maxsize=128)
+def _exact_rerank_fn(mesh: Mesh, k: int, metric: MetricType):
+    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -153,7 +180,7 @@ def sharded_exact_rerank(
         ids = jnp.take_along_axis(cids, pos, axis=1)
         return top_s, jnp.where(jnp.isfinite(top_s), ids, -1)
 
-    return run(queries, cand_ids, base, base_sqnorm)
+    return run
 
 
 def sharded_kmeans_step(
@@ -167,7 +194,12 @@ def sharded_kmeans_step(
     """One Lloyd round over sharded data: per-shard partial stats, psum
     over "data", identical centroid update everywhere (the distributed
     training step of the coarse quantizer / PQ codebooks)."""
+    return _kmeans_step_fn(mesh, chunk)(x, valid, centroids, reseed)
 
+
+@functools.lru_cache(maxsize=32)
+def _kmeans_step_fn(mesh: Mesh, chunk: int):
+    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -188,7 +220,7 @@ def sharded_kmeans_step(
         counts = jax.lax.psum(counts, "data")
         return km.centroids_from_partials(sums, counts, rs)
 
-    return step(x, valid, centroids, reseed)
+    return step
 
 
 def train_kmeans_sharded(
