@@ -1,0 +1,97 @@
+"""int4 mirror tier (TPU-native capacity knob: half the resident HBM
+per row of the int8 full-scan mirror; no reference analogue — the
+reference's capacity tier is DiskANN)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+from vearch_tpu.index.int8_mirror import Int8Mirror, quantize_rows_int4
+from vearch_tpu.ops.ivf import int4_scan_candidates, unpack_int4
+
+
+def test_int4_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((64, 20)).astype(np.float32)
+    packed, scale, vsq = quantize_rows_int4(rows)
+    assert packed.shape == (64, 10) and packed.dtype == np.uint8
+    un = np.asarray(unpack_int4(jnp.asarray(packed)), dtype=np.float32)
+    deq = un * scale[:, None]
+    # quantization error bounded by scale/2 per dim
+    assert np.max(np.abs(deq - rows)) <= np.max(scale) / 2 + 1e-6
+    np.testing.assert_allclose(vsq, (deq ** 2).sum(1), rtol=1e-3)
+
+
+def test_int4_mirror_halves_bytes():
+    m8 = Int8Mirror(64)
+    m4 = Int8Mirror(64, storage="int4")
+    rows = np.random.default_rng(1).standard_normal((1024, 64)).astype(
+        np.float32
+    )
+    m8.append(rows)
+    m4.append(rows)
+    assert m4._h8.nbytes * 2 == m8._h8.nbytes
+
+
+def test_int4_scan_self_match():
+    rng = np.random.default_rng(2)
+    n, d = 2048, 32
+    base = rng.standard_normal((n, d)).astype(np.float32) * 3
+    packed, scale, vsq = quantize_rows_int4(base)
+    q = base[rng.choice(n, 8, replace=False)]
+    valid = np.ones(n, bool)
+    s, ids = int4_scan_candidates(
+        jnp.asarray(q), jnp.asarray(packed), jnp.asarray(scale),
+        jnp.asarray(vsq), jnp.asarray(valid), 10, MetricType.L2,
+    )
+    ids = np.asarray(ids)
+    exact = np.argmin(
+        ((q[:, None].astype(np.float64)
+          - base[None].astype(np.float64)) ** 2).sum(-1), axis=1
+    )
+    assert (ids[:, 0] == exact).mean() >= 0.8, (ids[:, 0], exact)
+
+
+def test_ivfpq_int4_mirror_recall():
+    rng = np.random.default_rng(3)
+    n, d = 20_000, 32
+    centers = (rng.standard_normal((150, d)) * 3).astype(np.float32)
+    base = centers[rng.integers(0, 150, n)] + \
+        0.6 * rng.standard_normal((n, d)).astype(np.float32)
+    schema = TableSchema("i4", [
+        FieldSchema("v", DataType.VECTOR, dimension=d,
+                    index=IndexParams("IVFPQ", MetricType.L2, {
+                        "ncentroids": 128, "nsubvector": 8,
+                        "train_iters": 5, "training_threshold": n,
+                        "mirror_dtype": "int4",
+                    })),
+    ])
+    eng = Engine(schema)
+    for i in range(0, n, 10_000):
+        eng.upsert([{"_id": str(j), "v": base[j]}
+                    for j in range(i, i + 10_000)])
+    eng.build_index()
+    assert eng.indexes["v"]._mirror.storage == "int4"
+    q = base[:48] + 0.05 * rng.standard_normal((48, d)).astype(np.float32)
+    exact = np.argsort(
+        ((q[:, None].astype(np.float64)
+          - base[None].astype(np.float64)) ** 2).sum(-1), axis=1
+    )[:, :10]
+    res = eng.search(SearchRequest(vectors={"v": q}, k=10,
+                                   include_fields=[],
+                                   index_params={"rerank": 256}))
+    got = [[int(it.key) for it in r.items] for r in res]
+    r10 = float(np.mean([
+        len(set(got[i]) & set(exact[i].tolist())) / 10 for i in range(48)
+    ]))
+    assert r10 >= 0.8, r10
+    eng.close()
+
+
+def test_int4_odd_dimension_rejected():
+    with pytest.raises(ValueError, match="even dimension"):
+        Int8Mirror(33, storage="int4")

@@ -25,10 +25,49 @@ def quantize_rows(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]
     return q8, scale, vsq
 
 
+def quantize_rows_int4(
+    rows: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-row symmetric int4 quantization, nibble-packed.
+
+    Layout contract (ops/ivf.py unpack_int4): dims [0, d/2) in the low
+    nibble, dims [d/2, d) in the high nibble — concat, not interleave.
+    Returns (packed [n, d/2] uint8, scale, vsq of the DEQUANTIZED rows).
+    """
+    d = rows.shape[1]
+    assert d % 2 == 0, "int4 storage needs an even dimension"
+    scale = np.maximum(np.abs(rows).max(axis=1) / 7.0, 1e-12).astype(
+        np.float32
+    )
+    q4 = np.clip(np.rint(rows / scale[:, None]), -7, 7).astype(np.int8)
+    deq = q4.astype(np.float32) * scale[:, None]
+    vsq = np.sum(deq * deq, axis=1).astype(np.float32)
+    lo = q4[:, : d // 2] & 0xF
+    hi = q4[:, d // 2 :] & 0xF
+    packed = (lo | (hi << 4)).astype(np.uint8)
+    return packed, scale, vsq
+
+
 class Int8Mirror:
-    def __init__(self, dimension: int):
+    """Compressed device mirror; `storage` picks the tier:
+    - "int8" (default): 1 byte/dim, ~0.8% row-max quantization error;
+    - "int4": 0.5 byte/dim — HALF the resident HBM per row (the usual
+      rows-per-chip limiter), ~7% row-max error that the exact rerank
+      stage absorbs.
+    """
+
+    def __init__(self, dimension: int, storage: str = "int8"):
         self.dimension = dimension
-        self._h8 = np.zeros((0, dimension), dtype=np.int8)
+        self.storage = str(storage).lower()
+        if self.storage not in ("int8", "int4"):
+            raise ValueError(f"unknown mirror storage {storage!r}")
+        if self.storage == "int4" and dimension % 2 != 0:
+            raise ValueError("int4 mirror storage needs an even dimension")
+        width = dimension if self.storage == "int8" else dimension // 2
+        dt = np.int8 if self.storage == "int8" else np.uint8
+        self._row_width = width
+        self._row_dtype = dt
+        self._h8 = np.zeros((0, width), dtype=dt)
         self._h_scale = np.zeros(0, dtype=np.float32)
         self._h_vsq = np.zeros(0, dtype=np.float32)
         self._n = 0
@@ -53,7 +92,7 @@ class Int8Mirror:
             # the score row into [n/512, 512] blocks (ops/ivf.py)
             cap = max(need, self._h8.shape[0] * 2, 1024)
             cap = -(-cap // 512) * 512
-            g8 = np.zeros((cap, self.dimension), dtype=np.int8)
+            g8 = np.zeros((cap, self._row_width), dtype=self._row_dtype)
             gs = np.zeros(cap, dtype=np.float32)
             gv = np.zeros(cap, dtype=np.float32)
             g8[: self._n] = self._h8[: self._n]
@@ -73,7 +112,10 @@ class Int8Mirror:
             self._sh_cache.lower_rows(start)
 
     def append(self, rows: np.ndarray, start: int | None = None) -> None:
-        self.append_quantized(*quantize_rows(rows), start=start)
+        quant = (
+            quantize_rows if self.storage == "int8" else quantize_rows_int4
+        )
+        self.append_quantized(*quant(rows), start=start)
 
     def flush_sharded(self, mesh) -> tuple[jax.Array, jax.Array, jax.Array]:
         """Device views row-sharded over the mesh "data" axis — one
@@ -90,7 +132,7 @@ class Int8Mirror:
             self._sh_cache = ShardedRowCache(align=512)
 
         def build(cap):
-            h8 = np.zeros((cap, self.dimension), dtype=np.int8)
+            h8 = np.zeros((cap, self._row_width), dtype=self._row_dtype)
             hs = np.zeros(cap, dtype=np.float32)
             hv = np.zeros(cap, dtype=np.float32)
             n = self._n

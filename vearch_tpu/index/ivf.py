@@ -357,8 +357,14 @@ class IVFPQIndex(_IVFBase):
         self._bucket_resid8: jax.Array | None = None
         self._bucket_scale: jax.Array | None = None
         self._bucket_vsq: jax.Array | None = None
-        # full-scan-mode state (docid-ordered int8 mirror, append-only)
-        self._mirror = Int8Mirror(store.dimension)
+        # full-scan-mode state (docid-ordered compressed mirror,
+        # append-only). mirror_dtype "int4" halves resident HBM per row
+        # (the capacity knob for the full-scan regime).
+        self.mirror_storage = str(
+            params.get("mirror_dtype", "int8")
+        ).lower()
+        self._mirror = Int8Mirror(store.dimension,
+                                  storage=self.mirror_storage)
 
     def _train_extra(self, sample: np.ndarray) -> None:
         assign = np.asarray(
@@ -515,7 +521,12 @@ class IVFPQIndex(_IVFBase):
             topk_mode = (params or {}).get(
                 "topk_mode", self.params.get("topk_mode", "auto")
             )
-            cand_s, cand_i = ivf_ops.int8_scan_candidates(
+            scan = (
+                ivf_ops.int8_scan_candidates
+                if self.mirror_storage == "int8"
+                else ivf_ops.int4_scan_candidates
+            )
+            cand_s, cand_i = scan(
                 jnp.asarray(q), approx8, scale, vsq, valid,
                 max(r, k), metric, topk_mode,
             )
@@ -626,7 +637,7 @@ class IVFPQIndex(_IVFBase):
         )
         cand_s, cand_i = sharded_int8_search(
             mesh, a8, scale, vsq, valid_sh, qrep, max(r, k), metric,
-            topk_mode,
+            topk_mode, storage=self.mirror_storage,
         )
         base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
         scores, ids = sharded_exact_rerank(

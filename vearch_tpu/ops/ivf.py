@@ -255,6 +255,14 @@ def int8_scan_candidates(
     else:
         scores = dots
     scores = jnp.where(valid[None, :], scores, NEG_INF)
+    return _select_topk(scores, r, topk_mode)
+
+
+def _select_topk(
+    scores: jax.Array, r: int, topk_mode: str
+) -> tuple[jax.Array, jax.Array]:
+    """Shared block-max / exact top-r selection over a [B, N] score
+    matrix (see int8_scan_candidates docstring for the design note)."""
     b, n_pad = scores.shape
     r = min(r, n_pad)
     nb = max(32, r // 4)
@@ -287,6 +295,54 @@ def int8_scan_candidates(
     # resurrect them with genuine similarity scores (bf16 stage scores
     # are selection-only; the rerank stage recomputes exact scores)
     return top_s, jnp.where(jnp.isfinite(top_s), ids, -1)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """[N, d/2] uint8 nibble-packed -> [N, d] bf16 signed values.
+
+    Layout contract (index/int8_mirror.py quantize_rows_int4): dims
+    [0, d/2) live in the LOW nibble, dims [d/2, d) in the HIGH nibble —
+    a concat, not an interleave, so the unpack is two cheap vector ops
+    and one concatenate that XLA fuses into the consuming matmul.
+    """
+    lo = (packed & 0xF).astype(jnp.int8)
+    lo = lo - ((lo > 7) * jnp.int8(16))
+    hi = (packed >> 4).astype(jnp.int8)
+    hi = hi - ((hi > 7) * jnp.int8(16))
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("r", "metric", "topk_mode"))
+def int4_scan_candidates(
+    queries: jax.Array,    # [B, d] f32
+    packed4: jax.Array,    # [N_pad, d/2] uint8 nibble-packed int4 rows
+    row_scale: jax.Array,  # [N_pad] f32 per-row dequant scale
+    row_vsq: jax.Array,    # [N_pad] f32 ||approx||^2
+    valid: jax.Array,      # [N_pad] bool
+    r: int,
+    metric: MetricType = MetricType.L2,
+    topk_mode: str = "auto",
+) -> tuple[jax.Array, jax.Array]:
+    """int4 compressed full scan: the capacity tier of the int8 mirror.
+
+    Halves the RESIDENT HBM footprint of the scan structure (the usual
+    rows-per-chip limiter) at ~15-level quantization; the unpack to
+    bf16 is transient work the MXU matmul absorbs, and the exact rerank
+    stage recovers ordering exactly as it does for int8.
+    """
+    a = unpack_int4(packed4)  # [N, d] bf16
+    dots4 = jax.lax.dot_general(
+        queries.astype(jnp.bfloat16), a,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, N]
+    dots = dots4 * row_scale[None, :]
+    if metric is MetricType.L2:
+        scores = -(sqnorms(queries)[:, None] - 2.0 * dots + row_vsq[None, :])
+    else:
+        scores = dots
+    scores = jnp.where(valid[None, :], scores, NEG_INF)
+    return _select_topk(scores, r, topk_mode)
 
 
 @functools.partial(jax.jit, static_argnames=("r", "metric"))

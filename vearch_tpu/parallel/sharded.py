@@ -83,17 +83,21 @@ def sharded_int8_search(
     r: int,
     metric: MetricType = MetricType.L2,
     topk_mode: str = "auto",
+    storage: str = "int8",
 ) -> tuple[jax.Array, jax.Array]:
-    """Sharded compressed scan (the IVFPQ full-scan path across chips)."""
-    return _int8_search_fn(mesh, r, metric, topk_mode)(
+    """Sharded compressed scan (the IVFPQ full-scan path across chips).
+    `storage` follows the mirror tier: int8 rows or nibble-packed int4."""
+    return _int8_search_fn(mesh, r, metric, topk_mode, storage)(
         approx8, row_scale, row_vsq, valid, queries
     )
 
 
 @functools.lru_cache(maxsize=128)
 def _int8_search_fn(mesh: Mesh, r: int, metric: MetricType,
-                    topk_mode: str):
-    from vearch_tpu.ops.ivf import int8_scan_candidates
+                    topk_mode: str, storage: str = "int8"):
+    from vearch_tpu.ops.ivf import int4_scan_candidates, int8_scan_candidates
+
+    scan = int8_scan_candidates if storage == "int8" else int4_scan_candidates
 
     @jax.jit
     @functools.partial(
@@ -108,8 +112,7 @@ def _int8_search_fn(mesh: Mesh, r: int, metric: MetricType,
     )
     def run(a8, sc, vsq, v, q):
         local_r = min(r, a8.shape[0])
-        scores, ids = int8_scan_candidates(q, a8, sc, vsq, v, local_r,
-                                           metric, topk_mode)
+        scores, ids = scan(q, a8, sc, vsq, v, local_r, metric, topk_mode)
         shard = jax.lax.axis_index("data")
         # masked candidates come back as id=-1; keep them -1 globally
         # (a bare shard offset would turn them into real foreign docids)
