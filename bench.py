@@ -5,19 +5,25 @@ nlist=2048 m=32 nbits=8, batched queries, recall@10 target >= 0.95
 (verified against an exact scan each run; the run fails the recall gate
 rather than report a fast-but-wrong number).
 
-vs_baseline = TPU QPS / CPU QPS, where the CPU baseline is a vectorised
-numpy IVFPQ ADC scan (nprobe=32) over the *same* trained structures on
-this host — the in-situ stand-in for the reference's CPU engine (no faiss
-in this image; numpy ADC is the same algorithm the reference scans with).
+vs_baseline = TPU QPS / CPU QPS, where the CPU baseline is the strongest
+IVFPQ ADC scan this image allows (no faiss is installed): a vectorised
+batched-LUT numpy ADC (LUTs for all probed lists computed in one einsum,
+codes gathered in one indexed read) over the *same* trained structures,
+run across ALL host cores via multiprocessing. Both the single-process
+and the all-cores number are printed in the stderr diag along with the
+core count; vs_baseline divides by the parallel (larger) one. The
+reference engine's scan is the same ADC algorithm (OpenMP + AVX,
+/root/reference/internal/engine/index/impl/gamma_index_ivfpq.cc).
 
 Prints exactly one JSON line:
     {"metric": ..., "value": ..., "unit": "qps", "vs_baseline": ...}
 """
 
 import json
+import multiprocessing
 import os
+import subprocess
 import sys
-import threading
 import time
 
 import numpy as np
@@ -35,35 +41,49 @@ def _metric_name(batch: int) -> str:
     return "ivfpq_sift1m_like_search_qps_b1024_r@10>=0.95"
 
 
-def _require_device(timeout_s: float = 180.0):
-    """Fail fast (one JSON error line) when the TPU tunnel is down —
-    jax backend init otherwise blocks forever inside plugin discovery,
-    and a hung bench records nothing at all."""
-    out = {}
+def _emit_error(msg: str) -> None:
+    print(json.dumps({
+        "metric": _metric_name(64 if _capacity_mode() else 1024),
+        "value": 0,
+        "unit": "qps",
+        "vs_baseline": 0,
+        "error": msg,
+    }))
 
-    def probe():
+
+def _require_device(attempts: int = 3, timeout_s: float = 180.0,
+                    backoff_s: float = 30.0):
+    """Wait for the TPU tunnel, retrying with backoff (r2 recorded QPS=0
+    because a single 180s probe gave up on a flaky tunnel).
+
+    Each probe runs jax backend init in a SUBPROCESS: a hung init inside
+    this process would poison every later attempt (the plugin-discovery
+    lock never releases), while a killed subprocess leaves this process
+    clean to try again.
+    """
+    last_err = None
+    for i in range(attempts):
+        if i:
+            print(f"device probe retry {i + 1}/{attempts} "
+                  f"after {backoff_s:.0f}s", file=sys.stderr, flush=True)
+            time.sleep(backoff_s)
         try:
-            import jax
-
-            out["devices"] = [str(d) for d in jax.devices()]
-        except Exception as e:  # pragma: no cover
-            out["error"] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive() or "error" in out:
-        print(json.dumps({
-            "metric": _metric_name(64 if _capacity_mode() else 1024),
-            "value": 0,
-            "unit": "qps",
-            "vs_baseline": 0,
-            "error": out.get("error",
-                             f"jax backend init hung >{timeout_s:.0f}s "
-                             f"(TPU tunnel unavailable)"),
-        }))
-        sys.exit(1)
-    print(f"devices: {out['devices']}", file=sys.stderr, flush=True)
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print([str(d) for d in jax.devices()])"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if r.returncode == 0:
+                print(f"devices: {r.stdout.strip().splitlines()[-1]}",
+                      file=sys.stderr, flush=True)
+                return
+            last_err = (r.stderr.strip().splitlines() or ["exit != 0"])[-1]
+        except subprocess.TimeoutExpired:
+            last_err = (f"jax backend init hung >{timeout_s:.0f}s "
+                        f"(TPU tunnel unavailable)")
+        print(f"device probe failed: {last_err}", file=sys.stderr, flush=True)
+    _emit_error(f"{last_err} after {attempts} attempts")
+    sys.exit(1)
 
 
 def build_data(n=1_000_000, d=128, seed=0):
@@ -77,37 +97,101 @@ def build_data(n=1_000_000, d=128, seed=0):
     return base, queries
 
 
-def cpu_ivfpq_qps(index, queries, nprobe=32, n_queries=16):
-    """Reference-style CPU ADC scan over the same trained index state."""
-    cents = np.asarray(index.centroids)
-    cb = np.asarray(index.codebooks)  # [m, ksub, dsub]
-    m, ksub, dsub = cb.shape
-    codes = index._codes[: index.indexed_count]
-    members = [np.asarray(mm, dtype=np.int64) for mm in index._members]
+# --- CPU baseline -----------------------------------------------------------
+# Worker state is inherited over fork (Linux default start method); the
+# arrays are read-only in the workers so no copies are made.
+_CPU_STATE = {}
 
-    qs = queries[:n_queries].astype(np.float32)
-    t0 = time.time()
+
+def _cpu_init_state(index):
+    cents = np.asarray(index.centroids, dtype=np.float32)
+    cb = np.asarray(index.codebooks, dtype=np.float32)  # [m, ksub, dsub]
+    _CPU_STATE.update(
+        cents=cents,
+        cents_sq=(cents ** 2).sum(1),
+        cb=cb,
+        cb_sq=(cb ** 2).sum(-1),  # [m, ksub]
+        codes=index._codes[: index.indexed_count],
+        members=[np.asarray(mm, dtype=np.int64) for mm in index._members],
+    )
+
+
+def _cpu_adc_chunk(args):
+    """Batched-LUT ADC over a chunk of queries.
+
+    Per query: one matmul for coarse assign, ONE einsum building the LUTs
+    of all nprobe lists at once, one fancy-indexed gather over the
+    concatenated candidate codes. This is the vectorised formulation the
+    reference's OpenMP scan implements per-thread
+    (gamma_index_ivfpq.cc scan_list_with_table).
+    """
+    qs, nprobe, k = args
+    s = _CPU_STATE
+    cents, cents_sq = s["cents"], s["cents_sq"]
+    cb, cb_sq = s["cb"], s["cb_sq"]
+    codes, members = s["codes"], s["members"]
+    m, ksub, dsub = cb.shape
+    marange = np.arange(m)[None, :]
+    out = []
     for q in qs:
-        # coarse probe
-        d2c = ((cents - q) ** 2).sum(1)
+        d2c = cents_sq - 2.0 * (cents @ q)
         probes = np.argpartition(d2c, nprobe)[:nprobe]
-        cand_ids = []
-        cand_dist = []
-        for c in probes:
-            ids = members[c]
-            if ids.size == 0:
-                continue
-            resid = (q - cents[c]).reshape(m, dsub)
-            lut = ((cb - resid[:, None, :]) ** 2).sum(-1)  # [m, ksub]
-            cc = codes[ids]  # [nc, m]
-            dist = lut[np.arange(m)[None, :], cc].sum(1)
-            cand_ids.append(ids)
-            cand_dist.append(dist)
-        ids = np.concatenate(cand_ids)
-        dist = np.concatenate(cand_dist)
-        top = ids[np.argsort(dist)[:10]]
-    dt = time.time() - t0
-    return n_queries / dt
+        lists = [members[c] for c in probes]
+        sizes = np.array([l.size for l in lists])
+        ids = np.concatenate(lists)
+        seg = np.repeat(np.arange(len(probes)), sizes)
+        resid = (q[None, :] - cents[probes]).reshape(len(probes), m, dsub)
+        luts = (cb_sq[None] - 2.0 * np.einsum("pmd,mkd->pmk", resid, cb)
+                + (resid ** 2).sum(-1)[:, :, None])  # [p, m, ksub]
+        cc = codes[ids]  # [n, m]
+        dist = luts[seg[:, None], marange, cc].sum(1)
+        top = ids[np.argpartition(dist, min(k, dist.size - 1))[:k]]
+        out.append(top)
+    return out
+
+
+def cpu_ivfpq_qps(index, queries, nprobe=32, n_queries=32, k=10):
+    """Strongest CPU ADC run this image allows: vectorised batched-LUT
+    scan, single-process AND fanned across all host cores. Returns
+    (best_qps, diag-dict); vs_baseline divides by best_qps."""
+    _cpu_init_state(index)
+    qs = queries[:n_queries].astype(np.float32)
+
+    _cpu_adc_chunk((qs[:2], nprobe, k))  # warm caches
+    t0 = time.time()
+    _cpu_adc_chunk((qs, nprobe, k))
+    qps_1p = n_queries / (time.time() - t0)
+
+    ncores = os.cpu_count() or 1
+    qps_mp = 0.0
+    if ncores > 1:
+        # fork happens AFTER jax/TPU-runtime threads exist, so a child
+        # can deadlock on a mutex caught mid-fork — bound every pool op
+        # so a wedged child costs minutes, not the whole bench run
+        chunks = [(c, nprobe, k) for c in np.array_split(qs, ncores) if len(c)]
+        pool = multiprocessing.Pool(ncores)
+        try:
+            pool.map_async(
+                _cpu_adc_chunk, [(qs[:1], nprobe, k)] * ncores
+            ).get(timeout=120)  # warm
+            t0 = time.time()
+            pool.map_async(_cpu_adc_chunk, chunks).get(timeout=600)
+            qps_mp = n_queries / (time.time() - t0)
+        except multiprocessing.TimeoutError:
+            print("parallel CPU baseline timed out; using single-process",
+                  file=sys.stderr, flush=True)
+        finally:
+            pool.terminate()
+            pool.join()
+    best = max(qps_1p, qps_mp)
+    return best, {
+        "cpu_baseline_qps": round(best, 1),
+        "cpu_qps_1proc": round(qps_1p, 1),
+        "cpu_qps_allcores": round(qps_mp, 1),
+        "cpu_ncores": ncores,
+        "cpu_method": f"numpy batched-LUT ADC, nprobe={nprobe}, "
+                      "multiprocess over all cores; baseline = max",
+    }
 
 
 def main():
@@ -191,7 +275,7 @@ def main():
         len(got[q] & set(bi[q].tolist())) / 10 for q in range(batch)
     ]))
 
-    cpu_qps = cpu_ivfpq_qps(idx, queries)
+    cpu_qps, cpu_diag = cpu_ivfpq_qps(idx, queries)
     result = {
         "metric": _metric_name(batch),
         "value": round(qps, 1),
@@ -200,7 +284,7 @@ def main():
     }
     diag = {
         "recall_at_10": round(recall, 4),
-        "cpu_baseline_qps": round(cpu_qps, 1),
+        **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),
         "latency_ms_b1": round(lat[1] * 1e3, 1),
         "latency_ms_b32": round(lat[32] * 1e3, 1),
@@ -216,4 +300,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # never end without one parseable JSON line
+        import traceback
+
+        traceback.print_exc()
+        _emit_error(f"{type(e).__name__}: {e}")
+        sys.exit(1)

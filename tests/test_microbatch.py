@@ -196,3 +196,45 @@ def test_runtime_config_disables_batching(engine_and_data):
         assert before == after
     finally:
         eng.apply_config({"micro_batch": True})
+
+
+def test_group_failure_isolated_to_bad_request(engine_and_data):
+    """A co-batched request that poisons the SHARED dispatch (wrong
+    dimension makes the stack/concat or the device call fail) must not
+    fail its companymates: the group falls back to per-request runs and
+    only the bad request errors."""
+    eng, base = engine_and_data
+    mb = MicroBatcher(eng, max_rows=64)
+    try:
+        good = _Pending(SearchRequest(vectors={"v": base[1]}, k=2,
+                                      include_fields=[]), 1)
+        bad = _Pending(SearchRequest(
+            vectors={"v": np.zeros(D + 1, np.float32)}, k=2,
+            include_fields=[]), 1)
+        mb._run_group([good, bad])
+        assert good.done.is_set() and bad.done.is_set()
+        assert good.error is None
+        assert good.results[0].items[0].key == "1"
+        assert bad.error is not None
+    finally:
+        mb.stop()
+
+
+def test_apply_config_cannot_reenable_batching_after_close():
+    """close() stops the dispatcher; a late apply_config must not arm
+    the lazy-create path again (it would leak a dispatcher thread bound
+    to a closed engine)."""
+    schema = TableSchema("mc", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": "0", "v": np.zeros(D, np.float32)}])
+    eng.build_index()
+    eng.close()
+    eng.apply_config({"micro_batch": True})
+    assert eng.micro_batch is False
+    res = eng.search(SearchRequest(vectors={"v": np.zeros(D, np.float32)},
+                                   k=1, include_fields=[]))
+    assert res[0].items[0].key == "0"
+    assert eng._microbatcher is None

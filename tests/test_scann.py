@@ -165,3 +165,36 @@ def test_scann_default_nsubvector_clamps_to_dimension():
         vectors={"v": rng.standard_normal((4, 48))}, k=5, include_fields=[]
     ))
     assert len(res) == 4 and len(res[0].items) == 5
+
+
+def test_reordering_off_skips_raw_store_gather(mips_dataset, monkeypatch):
+    """reordering=false returns pure quantized scores with NO exact pass
+    (reference scann_api.h semantics) — the raw-store gather the flag
+    exists to avoid must not run."""
+    import vearch_tpu.index._store_paths as sp
+
+    base, queries, gt = mips_dataset
+    schema = TableSchema("s3", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("SCANN", MetricType.INNER_PRODUCT, {
+                        "ncentroids": 64, "nsubvector": 16,
+                        "train_iters": 4, "training_threshold": N,
+                        "reordering": False,
+                    })),
+    ])
+    eng = Engine(schema)
+    for i in range(0, N, 10_000):
+        eng.upsert([{"_id": str(j), "v": base[j]}
+                    for j in range(i, i + 10_000)])
+    eng.build_index()
+
+    def forbidden(*a, **k):
+        raise AssertionError("exact rerank ran despite reordering=false")
+
+    monkeypatch.setattr(sp, "rerank_against_store", forbidden)
+    r = _recalls(eng, queries, gt)
+    assert r[10] >= 0.6, r
+    # an explicit request-level rerank depth re-enables the exact pass
+    monkeypatch.undo()
+    r2 = _recalls(eng, queries, gt, {"rerank": 256})
+    assert r2[10] >= r[10]

@@ -182,3 +182,50 @@ def test_alias_resolved_cache_evicted_on_space_change(cluster):
         except rpc.RpcError:
             assert time.time() < deadline, "alias cache never refreshed"
             time.sleep(0.2)
+
+
+def test_watch_response_carries_stable_epoch(cluster):
+    """/watch responses name the master process instance: revs are
+    per-process counters, so routers key full resyncs on epoch change
+    instead of comparing rev magnitudes across processes."""
+    a = rpc.call(cluster.master_addr, "GET", "/watch",
+                 {"rev": 0, "timeout": 0.0})
+    b = rpc.call(cluster.master_addr, "GET", "/watch",
+                 {"rev": 0, "timeout": 0.0})
+    assert a["epoch"] and a["epoch"] == b["epoch"]
+
+
+def test_watch_epoch_change_forces_full_resync(cluster):
+    """A /watch answer from a DIFFERENT master process (failover across
+    the multi-master list, or restart) drops every router cache even
+    when the new rev is numerically ahead of ours."""
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    _mk_space(cl, "s", dim=D)
+    router = cluster.router
+    router.space_cache_ttl = 1e9
+    rng = np.random.default_rng(5)
+    v = rng.standard_normal(D).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": "x", "v": v}])
+    cl.search("db", "s", [{"field": "v", "feature": v}], limit=1)
+    assert router._space_cache  # warmed
+
+    orig = router._master_call
+
+    def imposter(method, path, body=None):
+        if path == "/watch":
+            # pretend a fresh master with interleaved-forward numbering
+            return {"rev": router._watch_rev + 100,
+                    "epoch": "imposter-epoch", "keys": []}
+        return orig(method, path, body)
+
+    router._master_call = imposter
+    try:
+        deadline = time.time() + 5.0
+        while router._watch_epoch != "imposter-epoch":
+            assert time.time() < deadline, "epoch never adopted"
+            time.sleep(0.05)
+        time.sleep(0.1)  # let the resync that rides the adoption land
+        assert not router._space_cache, "caches survived an epoch change"
+    finally:
+        router._master_call = orig

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Any
 
 from vearch_tpu.cluster import rpc
@@ -149,6 +150,11 @@ class MasterServer:
         # metadata changes instead of waiting out a TTL. Watches fire on
         # every master replica in log order, so any master serves them.
         self._watch_rev = 0
+        # per-process instance id: revs are process-local counters, so a
+        # router failing over between masters (or across a restart) must
+        # not compare revs from different epochs by magnitude — it keys a
+        # full resync on any epoch change instead
+        self._watch_epoch = uuid.uuid4().hex[:12]
         self._watch_ring: list[tuple[int, str]] = []  # (rev, key)
         self._watch_cond = threading.Condition()
 
@@ -161,8 +167,112 @@ class MasterServer:
 
         self.store.watch_prefix("", _on_meta_change)
 
+        # per-node partition stats riding PS heartbeats, in-memory only
+        # (a quorum write per 2s heartbeat would be absurd); feeds the
+        # cluster gauges below (reference: monitor_service.go:51-73)
+        self._node_stats: dict[int, dict[str, dict]] = {}
+        self._register_cluster_gauges()
+
         if self.replicated:
             self._setup_meta_raft()
+
+    def _register_cluster_gauges(self) -> None:
+        """Cluster-level /metrics gauges an operator graphs: servers,
+        dbs, spaces, partitions, per-space docs/sizes, leaders per node
+        (reference: internal/monitor/monitor_service.go:51-77)."""
+        m = self.server.metrics
+
+        def count_prefix(prefix: str):
+            return lambda: {(): float(len(self.store.prefix(prefix)))}
+
+        m.callback_gauge("vearch_cluster_servers",
+                         "registered PS servers", (),
+                         count_prefix(PREFIX_SERVER))
+        m.callback_gauge("vearch_cluster_fail_servers",
+                         "PS servers marked failed", (),
+                         count_prefix("/fail_server/"))
+        m.callback_gauge("vearch_cluster_dbs", "databases", (),
+                         count_prefix(PREFIX_DB))
+
+        # one scrape renders several space-derived gauges; parse the
+        # space metadata once per metadata revision instead of once per
+        # gauge (any store mutation bumps _watch_rev, so the memo can
+        # never serve a stale topology)
+        space_memo: dict = {"rev": -1, "spaces": []}
+
+        def _spaces():
+            with self._watch_cond:
+                rev = self._watch_rev
+            if space_memo["rev"] != rev:
+                space_memo["spaces"] = [
+                    Space.from_dict(d)
+                    for d in self.store.prefix(PREFIX_SPACE).values()
+                ]
+                space_memo["rev"] = rev
+            return space_memo["spaces"]
+
+        def spaces_per_db():
+            out: dict[tuple, float] = {}
+            for s in _spaces():
+                out[(s.db_name,)] = out.get((s.db_name,), 0.0) + 1.0
+            return out
+
+        m.callback_gauge("vearch_cluster_spaces", "spaces per db",
+                         ("db",), spaces_per_db)
+
+        def partitions_per_space():
+            return {(s.db_name, s.name): float(len(s.partitions))
+                    for s in _spaces()}
+
+        m.callback_gauge("vearch_cluster_partitions",
+                         "partitions per space", ("db", "space"),
+                         partitions_per_space)
+
+        def leaders_per_node():
+            out: dict[tuple, float] = {}
+            for s in _spaces():
+                for p in s.partitions:
+                    if p.leader >= 0:
+                        key = (str(p.leader),)
+                        out[key] = out.get(key, 0.0) + 1.0
+            return out
+
+        m.callback_gauge("vearch_cluster_partition_leaders",
+                         "partitions led per PS node", ("node_id",),
+                         leaders_per_node)
+
+        def _space_stat(field: str):
+            def fn():
+                out: dict[tuple, float] = {}
+                for s in _spaces():
+                    total = 0.0
+                    for p in s.partitions:
+                        # leader replica's report is authoritative; a
+                        # mid-failover gap falls back to the largest
+                        # replica report rather than dropping to zero
+                        best = None
+                        leader = self._node_stats.get(p.leader, {})
+                        st = leader.get(str(p.id))
+                        if st is not None:
+                            best = float(st.get(field, 0))
+                        else:
+                            for nid in p.replicas:
+                                st = self._node_stats.get(nid, {}).get(
+                                    str(p.id))
+                                if st is not None:
+                                    v = float(st.get(field, 0))
+                                    best = v if best is None else max(
+                                        best, v)
+                        total += best or 0.0
+                    out[(s.db_name, s.name)] = total
+                return out
+            return fn
+
+        m.callback_gauge("vearch_space_docs", "docs per space",
+                         ("db", "space"), _space_stat("doc_count"))
+        m.callback_gauge("vearch_space_size_bytes",
+                         "engine bytes per space", ("db", "space"),
+                         _space_stat("size_bytes"))
 
     # -- multi-master plumbing ----------------------------------------------
 
@@ -324,7 +434,8 @@ class MasterServer:
                 # or failed over — revs are per-process): make it resync
                 # now, not after a full idle poll window during which
                 # invalidations would be silently lost
-                return {"rev": self._watch_rev, "reset": True, "keys": []}
+                return {"rev": self._watch_rev, "epoch": self._watch_epoch,
+                        "reset": True, "keys": []}
         deadline = time.time() + timeout
         with self._watch_cond:
             while self._watch_rev <= rev and not self._stop.is_set():
@@ -335,14 +446,16 @@ class MasterServer:
             cur = self._watch_rev
             ring = list(self._watch_ring)
         if cur <= rev:
-            return {"rev": cur, "keys": []}
+            return {"rev": cur, "epoch": self._watch_epoch, "keys": []}
         oldest = ring[0][0] if ring else cur + 1
         if rev + 1 < oldest:
             # the caller missed events beyond the ring: tell it to drop
             # everything rather than serve a partial delta as complete
-            return {"rev": cur, "reset": True, "keys": []}
+            return {"rev": cur, "epoch": self._watch_epoch,
+                    "reset": True, "keys": []}
         return {
             "rev": cur,
+            "epoch": self._watch_epoch,
             "keys": sorted({k for r, k in ring if r > rev}),
         }
 
@@ -383,6 +496,11 @@ class MasterServer:
                         self.store.put(f"/fail_server/{node_id}", {
                             "node_id": node_id, "time": time.time(),
                         })
+                        # drop its last heartbeat stats: serving a dead
+                        # node's doc/size report as current (via the
+                        # replica-fallback in the space gauges) would
+                        # show stale numbers for the process lifetime
+                        self._node_stats.pop(node_id, None)
                         self._failover_node(node_id)
             except Exception as e:
                 # store mutations propose through the meta log and can
@@ -679,6 +797,8 @@ class MasterServer:
             # guarded: an unconditional delete would cost a quorum
             # proposal on every heartbeat in replicated mode
             self.store.delete(f"/fail_server/{node_id}")
+        if "partitions" in body:
+            self._node_stats[node_id] = body["partitions"] or {}
         return {"node_id": node_id}
 
     def _h_servers(self, _body, _parts) -> dict:

@@ -56,6 +56,29 @@ class Gauge(Counter):
         return super().render().replace(" counter", " gauge", 1)
 
 
+class CallbackGauge:
+    """Gauge whose samples are computed at scrape time (reference:
+    monitor_service.go:51-73 cluster gauges are refreshed from master +
+    etcd state on collection — pull-time evaluation gives the same
+    freshness without a scrape loop). `fn` returns
+    {label_values_tuple: value}; unlabelled gauges return {(): value}."""
+
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...], fn):
+        self.name, self.help, self.labels, self.fn = name, help_, labels, fn
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        try:
+            values = self.fn() or {}
+        except Exception:  # a scrape must never 500 the /metrics page
+            values = {}
+        for lv, v in sorted(values.items()):
+            lv = tuple(str(x) for x in lv)
+            lines.append(f"{self.name}{_fmt_labels(self.labels, lv)} {v}")
+        return "\n".join(lines)
+
+
 class Histogram:
     def __init__(
         self,
@@ -119,6 +142,12 @@ class Registry:
 
     def gauge(self, name, help_, labels=()) -> Gauge:
         m = Gauge(name, help_, labels)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def callback_gauge(self, name, help_, labels, fn) -> CallbackGauge:
+        m = CallbackGauge(name, help_, labels, fn)
         with self._lock:
             self._metrics.append(m)
         return m

@@ -61,6 +61,7 @@ class RouterServer:
         # canonical "db/space" -> alias cache keys resolved through it
         self._alias_backmap: dict[str, set[str]] = {}
         self._watch_rev = 0
+        self._watch_epoch: str | None = None
         self._watch_stop = threading.Event()
 
         self.server = JsonRpcServer(
@@ -112,11 +113,19 @@ class RouterServer:
                 self._watch_stop.wait(1.0)
                 continue
             new_rev = int(out.get("rev", self._watch_rev))
+            epoch = out.get("epoch")
+            if epoch != self._watch_epoch:
+                # a different master process answered (failover across
+                # the multi-master list, or a restart): its rev counter
+                # shares no history with ours, so magnitude comparison
+                # is meaningless — adopt the new epoch and resync fully
+                self._watch_epoch = epoch
+                self._watch_rev = new_rev
+                self._invalidate_caches()
+                continue
             if new_rev < self._watch_rev:
-                # revision went BACKWARDS: watch revs are per-master
-                # process counters, so a failover/restart restarts the
-                # numbering — any delta we think we have is meaningless.
-                # Resync by dropping everything.
+                # same process, revision went BACKWARDS (shouldn't
+                # happen; defensive): drop everything
                 self._watch_rev = new_rev
                 self._invalidate_caches()
                 continue

@@ -302,9 +302,13 @@ class Engine:
         index at refresh_interval cadence so searches never pay the
         absorb cost inline (reference: engine.cc:1106-1158 Indexing loop
         sleeping refresh_interval_ between AddRTVecsToIndex passes)."""
-        if getattr(self, "_refresh_thread", None) is not None:
-            return
-        self._closed = threading.Event()
+        with self._write_lock:  # ordered against close()'s _closed write
+            if getattr(self, "_refresh_thread", None) is not None:
+                return
+            if (getattr(self, "_closed", None) is not None
+                    and self._closed.is_set()):
+                return  # closed engines stay closed
+            self._closed = threading.Event()
 
         def loop():
             while not self._closed.wait(
@@ -321,12 +325,18 @@ class Engine:
         self._refresh_thread.start()
 
     def close(self) -> None:
-        if getattr(self, "_closed", None) is not None:
-            self._closed.set()
-        # under _write_lock, mirroring the lazy creation in search():
-        # otherwise a concurrent search could construct a fresh batcher
-        # after this stop, leaking a dispatcher bound to a closed engine
+        # under _write_lock, mirroring the lazy creation in search() and
+        # the _closed creation in start_refresh_loop(): otherwise a
+        # concurrent search could construct a fresh batcher after this
+        # stop (or a racing start_refresh_loop could clobber the set
+        # event with a fresh one), leaking threads bound to a closed
+        # engine
         with self._write_lock:
+            if getattr(self, "_closed", None) is None:
+                # no refresh loop ever started; still record closedness
+                # so apply_config can't re-enable micro-batching later
+                self._closed = threading.Event()
+            self._closed.set()
             self.micro_batch = False
             if self._microbatcher is not None:
                 self._microbatcher.stop()
@@ -342,7 +352,14 @@ class Engine:
         if "training_threshold" in cfg:
             self.schema.training_threshold = int(cfg["training_threshold"])
         if "micro_batch" in cfg:
-            self.micro_batch = bool(cfg["micro_batch"])
+            # under _write_lock to order against close(): an unlocked
+            # check could pass just before close() completes and then
+            # re-enable batching on the closed engine — search() would
+            # lazily spawn a dispatcher thread bound to a dead engine
+            with self._write_lock:
+                closed = getattr(self, "_closed", None)
+                if closed is None or not closed.is_set():
+                    self.micro_batch = bool(cfg["micro_batch"])
         if "micro_batch_max_rows" in cfg:
             self.micro_batch_max_rows = int(cfg["micro_batch_max_rows"])
             mb = self._microbatcher

@@ -188,10 +188,22 @@ class MicroBatcher:
                 trace=trace,
             )
             results = self.engine._search_direct(big)
-        except Exception as e:
+        except Exception:
+            # One bad co-batched request (wrong dim, NaNs, ...) must not
+            # fail its companymates: retry each pending alone so only the
+            # genuinely bad ones error. Killed requests get their abort
+            # instead of a full-cost re-run (same as the success path).
             for p in group:
-                p.error = e
-                p.done.set()
+                try:
+                    if p.req.ctx is not None and p.req.ctx.killed:
+                        p.error = RequestKilled(
+                            p.req.ctx.reason or "request killed")
+                    else:
+                        p.results = self.engine._search_direct(p.req)
+                except Exception as e:
+                    p.error = e
+                finally:
+                    p.done.set()
             return
         off = 0
         for p in group:

@@ -201,6 +201,11 @@ class _IVFBase(VectorIndex):
         r = int(p.get("rerank", self.params.get("rerank", max(10 * k, 128))))
         return max(r, k)
 
+    def _exact_rerank_enabled(self, params: dict | None) -> bool:
+        """Whether the exact raw-store rerank pass runs after the
+        quantized scan. SCANN's reordering=false flips this off."""
+        return True
+
     def _nprobe(self, params: dict | None) -> int:
         p = params or {}
         return min(int(p.get("nprobe", self.default_nprobe)), self.nlist)
@@ -581,6 +586,11 @@ class IVFPQIndex(_IVFBase):
                     probes=None if host_probes is None
                     else jnp.asarray(host_probes),
                 )
+        if not self._exact_rerank_enabled(params):
+            # SCANN reordering=false: pure quantized scores, no raw-store
+            # gather (candidates come out of the scan best-first)
+            scores, ids = jax.device_get((cand_s, cand_i))
+            return self._pad_to_k(scores[:, :k], ids[:, :k], k)
         from vearch_tpu.index._store_paths import rerank_against_store
 
         scores, ids = rerank_against_store(
@@ -639,6 +649,9 @@ class IVFPQIndex(_IVFBase):
             mesh, a8, scale, vsq, valid_sh, qrep, max(r, k), metric,
             topk_mode, storage=self.mirror_storage,
         )
+        if not self._exact_rerank_enabled(params):
+            scores, ids = jax.device_get((cand_s, cand_i))
+            return self._pad_to_k(scores[:, :k], ids[:, :k], k)
         base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
         scores, ids = sharded_exact_rerank(
             mesh, qrep.astype(base.dtype), cand_i, base, base_sqn,

@@ -67,13 +67,17 @@ class ScannIndex(IVFPQIndex):
             resid, self._unit_dirs(rows), self.codebooks, self.eta,
         ))
 
+    def _exact_rerank_enabled(self, params: dict | None) -> bool:
+        # reference reordering=false returns pure quantized scores with
+        # NO exact pass (scann_api.h reordering); an explicit rerank
+        # depth — request OR index level — re-enables it
+        if self.reordering:
+            return True
+        return bool(
+            (params or {}).get("rerank") or self.params.get("rerank")
+        )
+
     def _rerank_depth(self, k: int, params: dict | None) -> int:
-        # an explicit rerank depth — request OR index level — overrides
-        # reordering=false, matching the base class's lookup order
-        if (
-            not self.reordering
-            and not (params or {}).get("rerank")
-            and not self.params.get("rerank")
-        ):
-            return k  # reordering=false: trust the quantized scores
+        if not self._exact_rerank_enabled(params):
+            return k  # candidate depth = k: no rerank pass consumes more
         return super()._rerank_depth(k, params)
