@@ -1,0 +1,191 @@
+"""Segmented append-only persistence (r2 VERDICT weak #5 / next-5).
+
+Rows are immutable once appended (updates append + soft-delete), so a
+flush seals rows since the last dump into ONE new segment and only
+rewrites small mutable artifacts (bitmap, index state, MANIFEST). The
+manifest commit is an atomic rename; sealed segment files are never
+touched again (reference behavior: incremental RocksDB writes,
+internal/engine/storage/storage_manager.h:21, periodic flush job
+raftstore/store_raft_job.go:97).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+
+D = 8
+
+
+def mk_engine(data_dir=None, with_scalar=True):
+    fields = [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ]
+    if with_scalar:
+        fields += [
+            FieldSchema("price", DataType.INT),
+            FieldSchema("tag", DataType.STRING),
+        ]
+    schema = TableSchema("seg", fields)
+    return Engine(schema, data_dir=data_dir)
+
+
+def upsert(eng, lo, hi, rng, tag="a"):
+    vecs = rng.standard_normal((hi - lo, D)).astype(np.float32)
+    eng.upsert([
+        {"_id": f"d{i}", "v": vecs[i - lo], "price": i, "tag": tag}
+        for i in range(lo, hi)
+    ])
+    return vecs
+
+
+def seg_files(dirpath):
+    """{relpath: mtime_ns} for every file under segments/."""
+    out = {}
+    root = os.path.join(dirpath, "segments")
+    for dp, _dirs, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dp, f)
+            out[os.path.relpath(p, root)] = os.stat(p).st_mtime_ns
+    return out
+
+
+def test_roundtrip_with_updates_and_deletes(tmp_path, rng):
+    d = str(tmp_path / "e")
+    eng = mk_engine(d)
+    upsert(eng, 0, 500, rng)
+    # updates append a new row + soft-delete the old one
+    v2 = rng.standard_normal((50, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "v": v2[i], "price": 10_000 + i,
+                 "tag": "upd"} for i in range(50)])
+    eng.delete([f"d{i}" for i in range(100, 120)])
+    eng.build_index()
+    eng.dump()
+
+    eng2 = Engine.open(d)
+    assert eng2.doc_count == eng.doc_count
+    # updated doc resolves to the new row
+    doc = eng2.get(["d7"])[0]
+    assert doc["price"] == 10_007 and doc["tag"] == "upd"
+    # deleted keys are gone (key->docid reconstruction honors the bitmap)
+    assert eng2.get(["d105"]) == []
+    # updated vector wins the search
+    res = eng2.search(SearchRequest(vectors={"v": v2[3]}, k=1,
+                                    include_fields=["price"]))
+    assert res[0].items[0].key == "d3"
+    assert res[0].items[0].fields["price"] == 10_003
+
+
+def test_second_flush_writes_only_new_segment(tmp_path, rng):
+    d = str(tmp_path / "e")
+    eng = mk_engine(d)
+    upsert(eng, 0, 1000, rng)
+    eng.build_index()
+    eng.dump()
+    before = seg_files(d)
+    assert len({os.path.dirname(p) for p in before}) == 1
+
+    upsert(eng, 1000, 1100, rng, tag="b")
+    eng.dump()
+    after = seg_files(d)
+    # sealed files untouched (same mtime), exactly one new segment dir
+    for p, mt in before.items():
+        assert after[p] == mt, f"sealed segment file rewritten: {p}"
+    dirs = {os.path.dirname(p) for p in after}
+    assert len(dirs) == 2
+    m = json.load(open(os.path.join(d, "MANIFEST.json")))
+    assert [s["start"] for s in m["segments"]] == [0, 1000]
+    assert m["doc_count"] == 1100
+
+    eng2 = Engine.open(d)
+    assert eng2.doc_count == 1100
+    assert eng2.get(["d1050"])[0]["tag"] == "b"
+
+
+def test_noop_flush_adds_no_segment(tmp_path, rng):
+    d = str(tmp_path / "e")
+    eng = mk_engine(d)
+    upsert(eng, 0, 300, rng)
+    eng.dump()
+    n1 = len(json.load(open(os.path.join(d, "MANIFEST.json")))["segments"])
+    eng.dump()  # nothing new
+    m = json.load(open(os.path.join(d, "MANIFEST.json")))
+    assert len(m["segments"]) == n1
+
+
+def test_small_segment_compaction_bounds_count(tmp_path, rng):
+    d = str(tmp_path / "e")
+    eng = mk_engine(d)
+    eng.SEGMENT_TARGET_ROWS = 200  # instance override for the test
+    lo = 0
+    for _ in range(30):  # 30 small flushes of 50 rows
+        upsert(eng, lo, lo + 50, rng)
+        lo += 50
+        eng.dump()
+    m = json.load(open(os.path.join(d, "MANIFEST.json")))
+    # without compaction this would be 30 segments
+    assert len(m["segments"]) <= eng.MAX_SMALL_SEGMENTS + 2, m["segments"]
+    eng2 = Engine.open(d)
+    assert eng2.doc_count == lo
+    assert eng2.get(["d1234"])[0]["price"] == 1234
+
+
+def test_rewind_reseals_tail(tmp_path, rng):
+    """A restore rewinds the partition; dumping a SMALLER state over an
+    existing manifest must discard the now-invalid tail segments."""
+    d = str(tmp_path / "e")
+    a = mk_engine(d)
+    upsert(a, 0, 400, rng)
+    a.dump()
+    b = mk_engine(d)
+    upsert(b, 0, 150, rng, tag="rewound")
+    b.dump()
+    m = json.load(open(os.path.join(d, "MANIFEST.json")))
+    assert m["doc_count"] == 150
+    assert all(s["end"] <= 150 for s in m["segments"])
+    eng2 = Engine.open(d)
+    assert eng2.doc_count == 150
+    assert eng2.get(["d260"]) == []
+    assert eng2.get(["d100"])[0]["tag"] == "rewound"
+
+
+@pytest.mark.slow
+def test_recovery_at_1m_rows(tmp_path):
+    """VERDICT next-5 'done' bar: recovery at >=1M rows, and the second
+    flush after a small delta stays O(delta)."""
+    d = str(tmp_path / "big")
+    eng = mk_engine(d, with_scalar=False)
+    rng = np.random.default_rng(0)
+    n = 1_000_000
+    step = 100_000
+    for lo in range(0, n, step):
+        vecs = rng.standard_normal((step, D)).astype(np.float32)
+        eng.upsert([{"_id": f"d{i}", "v": vecs[i - lo]}
+                    for i in range(lo, lo + step)])
+    eng.dump()
+    before = seg_files(d)
+
+    vecs = rng.standard_normal((10, D)).astype(np.float32)
+    eng.upsert([{"_id": f"x{i}", "v": vecs[i]} for i in range(10)])
+    import time
+    t0 = time.time()
+    eng.dump()
+    dt_incr = time.time() - t0
+    after = seg_files(d)
+    for p, mt in before.items():
+        assert after[p] == mt
+    # O(delta): the incremental flush must not rewrite the 1M-row state
+    # (full dump takes seconds; the delta is 10 rows)
+    assert dt_incr < 2.0, dt_incr
+
+    eng2 = Engine.open(d)
+    assert eng2.doc_count == n + 10
+    assert eng2.get(["x7"]) != []
+    assert eng2.get(["d999999"]) != []

@@ -136,6 +136,7 @@ class MasterServer:
         s.route("GET", "/partitions", self._h_partitions)
         s.route("POST", "/partitions/change_member", self._h_change_member)
         s.route("POST", "/partitions/rule", self._h_partition_rule)
+        s.route("POST", "/field_index", self._h_field_index)
         s.route("POST", "/config", self._h_set_config)
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)

@@ -182,3 +182,25 @@ class DiskRawVectorStore(RawVectorStore):
                 self._host[lo:hi] = data[lo:hi]
             self._n = data.shape[0]
             self.flush_disk()
+
+    def load_parts(self, paths: list[str]) -> None:
+        """Segmented restore: stream each segment slice into the mmap in
+        row order (foreign-dir backups of a disk store; in-place dumps
+        carry no vector segments — load() rolls back via meta.json)."""
+        if not paths:  # in-place dump: Engine.load uses load() instead
+            return
+        self._n = 0
+        total = 0
+        for p in paths:
+            data = np.load(p, mmap_mode="r")
+            if self._host.shape[0] < total + data.shape[0]:
+                self._host = self._map(
+                    max(total + data.shape[0], self._host.shape[0] * 2)
+                )
+            step = max(1, (64 << 20) // (self.dimension * 4))
+            for lo in range(0, data.shape[0], step):
+                hi = min(lo + step, data.shape[0])
+                self._host[total + lo : total + hi] = data[lo:hi]
+            total += data.shape[0]
+        self._n = total
+        self.flush_disk()

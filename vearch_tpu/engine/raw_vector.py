@@ -145,3 +145,23 @@ class RawVectorStore:
             if self._sh_cache is not None:
                 self._sh_cache.invalidate()
             self._sh_sqnorm = None
+
+    def load_parts(self, paths: list[str]) -> None:
+        """Restore from per-segment row slices in order (segmented dump
+        format; Engine.load concatenates MANIFEST segments)."""
+        if not paths:
+            return
+        parts = [np.load(p) for p in paths]
+        n = sum(p.shape[0] for p in parts)
+        host = np.zeros((max(n, 1024), self.dimension), dtype=np.float32)
+        off = 0
+        for p in parts:
+            host[off : off + p.shape[0]] = p
+            off += p.shape[0]
+        self._host = host
+        self._n = n
+        self._device = None
+        self._device_rows = 0
+        if self._sh_cache is not None:
+            self._sh_cache.invalidate()
+        self._sh_sqnorm = None

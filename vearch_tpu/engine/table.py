@@ -189,3 +189,26 @@ class Table:
             arr = data[name]
             col._data = arr.copy()
             col._n = arr.shape[0]
+
+    def load_from_segments(
+        self,
+        keys: list[str],
+        strings: dict[str, list],
+        fixed: dict[str, np.ndarray],
+        alive_mask: np.ndarray,
+    ) -> None:
+        """Restore from concatenated segment slices. key→docid is NOT
+        persisted in the segmented format — it is derivable: an update
+        appends a new row and soft-deletes the old one, so for any key
+        only its LATEST row can be alive, and the map is exactly
+        {key: docid | alive[docid]} (deleted keys' last rows are dead)."""
+        self._keys = keys
+        self._strings = strings
+        for name, col in self._fixed.items():
+            arr = fixed[name]
+            col._data = arr.copy() if arr.base is not None else arr
+            col._n = arr.shape[0]
+        alive = np.asarray(alive_mask, dtype=bool)
+        self._key_to_docid = {
+            keys[d]: d for d in np.flatnonzero(alive[: len(keys)]).tolist()
+        }

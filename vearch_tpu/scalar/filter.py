@@ -99,8 +99,10 @@ def _eval_strings(rows: list[Any], cond: Condition, n: int) -> np.ndarray:
 def evaluate_condition(cond: Condition, engine, n: int) -> np.ndarray:
     """[n] bool mask for one condition; prefers a scalar index."""
     mgr = engine._scalar_manager
-    if mgr is not None and mgr.has_index(cond.field):
-        return mgr.query(cond, n)
+    if mgr is not None:
+        mask = mgr.query_if_indexed(cond, n)
+        if mask is not None:
+            return mask
     schema_field = engine.schema.field(cond.field)
     table = engine.table
     try:

@@ -51,6 +51,21 @@ class ScalarIndexManager:
     def has_index(self, field: str) -> bool:
         return field in self._indexes
 
+    def query_if_indexed(self, cond: Condition, n: int):
+        """Mask from the field's index, or None when the field has no
+        index — tolerant of a concurrent remove_field between the
+        caller's has_index check and the lookup (online index drop,
+        reference: RemoveFieldIndex gamma_api.h:181)."""
+        index = self._indexes.get(cond.field)
+        return None if index is None else index.query(cond, n)
+
+    def add_field(self, name: str, index) -> None:
+        """Publish a (fully built) per-field index atomically."""
+        self._indexes[name] = index
+
+    def remove_field(self, name: str) -> None:
+        self._indexes.pop(name, None)
+
     def composites(self) -> list:
         """Declared composite indexes, for the filter planner
         (reference: scalar_index_manager.h FilterIndexPair)."""
