@@ -138,6 +138,9 @@ def _cpu_adc_chunk(args):
         probes = np.argpartition(d2c, nprobe)[:nprobe]
         lists = [members[c] for c in probes]
         sizes = np.array([l.size for l in lists])
+        if sizes.sum() == 0:  # every probed list empty: nothing to rank
+            out.append(np.empty(0, dtype=np.int64))
+            continue
         ids = np.concatenate(lists)
         seg = np.repeat(np.arange(len(probes)), sizes)
         resid = (q[None, :] - cents[probes]).reshape(len(probes), m, dsub)

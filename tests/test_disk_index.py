@@ -263,7 +263,11 @@ class TestEngineDiskTier:
         )
         backup = str(tmp_path / "eng_backup")
         eng.dump(backup)
-        assert os.path.exists(os.path.join(backup, "vectors_v.npy"))
+        import glob
+
+        assert glob.glob(
+            os.path.join(backup, "segments", "seg_*", "vectors_v.npy")
+        ), "sibling-dir dump must materialize vector payloads"
 
     def test_bfloat16_disk_store(self, tmp_path):
         store = DiskRawVectorStore(

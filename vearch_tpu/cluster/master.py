@@ -29,7 +29,7 @@ from vearch_tpu.cluster.entities import (
 from vearch_tpu.cluster.hashing import carve_slots
 from vearch_tpu.cluster.metastore import MetaStore
 from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
-from vearch_tpu.engine.types import TableSchema
+from vearch_tpu.engine.types import DataType, ScalarIndexType, TableSchema
 from vearch_tpu.utils import log
 
 _log = log.get("master")
@@ -800,7 +800,16 @@ class MasterServer:
             self.store.delete(f"/fail_server/{node_id}")
         if "partitions" in body:
             self._node_stats[node_id] = body["partitions"] or {}
-        return {"node_id": node_id}
+        # field-index expectations for the partitions this node hosts:
+        # heals replicas that missed a /field_index fan-out (transient
+        # RPC failure, or a restart that reloaded a stale local schema)
+        expect = self._field_index_expectations()
+        hosted = {str(pid) for pid in server.partition_ids}
+        return {"node_id": node_id,
+                "field_indexes": {
+                    pid: flags for pid, flags in expect.items()
+                    if pid in hosted
+                }}
 
     def _h_servers(self, _body, _parts) -> dict:
         return {"servers": list(self.store.prefix(PREFIX_SERVER).values())}
@@ -1296,6 +1305,99 @@ class MasterServer:
             raise RpcError(400, f"unknown operator_type {op!r}")
         self.store.put(key, space.to_dict())
         return space.to_dict()
+
+    def _h_field_index(self, body: dict, _parts) -> dict:
+        """Online scalar field-index add/remove (reference:
+        c_api/gamma_api.h:166,181 AddFieldIndexWithParams/RemoveFieldIndex;
+        Go seam gammacb/gamma.go:538,591). The master persists the schema
+        change first — so recovered or newly placed replicas build the
+        index at load — then fans the op out to EVERY replica of every
+        partition: scalar indexes are engine-local structures, not
+        replicated state, so each engine builds its own."""
+        db, name = body["db_name"], body["space_name"]
+        fname = body["field"]
+        op = str(body.get("operator_type", "ADD")).upper()
+        itype = str(body.get("index_type", "INVERTED")).upper()
+        if op == "DROP":
+            itype = "NONE"
+        elif op != "ADD":
+            raise RpcError(400, f"unknown operator_type {op!r}")
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        # lock covers ONLY the schema read-modify-write: the fan-out below
+        # can outlive the lock TTL (sync builds, slow replicas) and does
+        # not touch the space record
+        if not self.store.try_lock("space_create", f"{db}/{name}"):
+            raise RpcError(409, "space mutation in progress")
+        try:
+            sp = self.store.get(key)
+            if sp is None:
+                raise RpcError(404, f"space {db}/{name} not found")
+            space = Space.from_dict(sp)
+            f = next(
+                (x for x in space.schema.fields if x.name == fname), None
+            )
+            if f is None:
+                raise RpcError(404, f"field {fname!r} not found")
+            if f.data_type is DataType.VECTOR:
+                raise RpcError(400, f"{fname!r} is a vector field")
+            try:
+                f.scalar_index = ScalarIndexType(itype)
+            except ValueError:
+                raise RpcError(400, f"unknown index_type {itype!r}") from None
+            self.store.put(key, space.to_dict())
+        finally:
+            self.store.unlock("space_create", f"{db}/{name}")
+
+        # best-effort fan-out: a replica that misses it (dead, or a
+        # transient RPC failure) converges anyway — field-index
+        # expectations ride every heartbeat response and the PS
+        # reconciles its engines against them (_h_register below)
+        servers = {s.node_id: s for s in self._alive_servers()}
+        acked: list[list[int]] = []
+        failed: list[list[int]] = []
+        req = {
+            "field": fname,
+            "index_type": itype,
+            "background": bool(body.get("background", True)),
+        }
+        for part in space.partitions:
+            for node_id in part.replicas:
+                srv = servers.get(node_id)
+                if srv is None:
+                    failed.append([part.id, node_id])
+                    continue
+                try:
+                    rpc.call(srv.rpc_addr, "POST", "/ps/field_index",
+                             {**req, "partition_id": part.id})
+                    acked.append([part.id, node_id])
+                except RpcError:
+                    failed.append([part.id, node_id])
+        return {"field": fname, "index_type": itype,
+                "acked": acked, "failed": failed}
+
+    def _field_index_expectations(self) -> dict[str, dict[str, str]]:
+        """{partition_id: {field: index_type}} over all spaces — the
+        master-side truth PS nodes reconcile against each heartbeat.
+        Cached on the watch revision (bumped by every store mutation) so
+        the per-2s-heartbeat cost is a dict lookup, not a space scan."""
+        with self._watch_cond:
+            rev = self._watch_rev
+        cached = getattr(self, "_fidx_cache", None)
+        if cached is not None and cached[0] == rev:
+            return cached[1]
+        out: dict[str, dict[str, str]] = {}
+        for sp in self.store.prefix(PREFIX_SPACE).values():
+            space = Space.from_dict(sp)
+            flags = {
+                f.name: f.scalar_index.value
+                for f in space.schema.fields
+                if f.data_type is not DataType.VECTOR
+                and f.scalar_index is not ScalarIndexType.NONE
+            }
+            for part in space.partitions:
+                out[str(part.id)] = flags
+        self._fidx_cache = (rev, out)
+        return out
 
     def _drop_partitions(self, parts: list[Partition], servers) -> None:
         """Delete partitions on their replicas and trim the ids from the

@@ -23,7 +23,7 @@ import time
 from typing import Any
 
 from vearch_tpu.engine.engine import Engine, SearchRequest
-from vearch_tpu.engine.types import TableSchema
+from vearch_tpu.engine.types import DataType, TableSchema
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Partition
 from vearch_tpu.cluster.raft import RaftNode
@@ -139,6 +139,7 @@ class PSServer:
         s.route("POST", "/ps/doc/search", self._h_search)
         s.route("POST", "/ps/doc/query", self._h_query)
         s.route("POST", "/ps/index/build", self._h_build)
+        s.route("POST", "/ps/field_index", self._h_field_index)
         s.route("POST", "/ps/index/rebuild", self._h_rebuild)
         s.route("POST", "/ps/flush", self._h_flush)
         s.route("POST", "/ps/engine/config", self._h_engine_config)
@@ -264,7 +265,7 @@ class PSServer:
         while not self._stop.is_set():
             time.sleep(self.heartbeat_interval)
             try:
-                rpc.call(
+                resp = rpc.call(
                     self.master_addr, "POST", "/register",
                     {"rpc_addr": self.addr, "node_id": self.node_id,
                      "labels": self.labels,
@@ -272,7 +273,32 @@ class PSServer:
                     auth=self.master_auth,
                 )
             except RpcError:
-                pass
+                continue
+            try:
+                self._reconcile_field_indexes(
+                    resp.get("field_indexes") or {}
+                )
+            except Exception:
+                _log.exception("field-index reconcile failed")
+
+    def _reconcile_field_indexes(
+        self, expect: dict[str, dict[str, str]]
+    ) -> None:
+        """Converge each engine's scalar-index flags onto the master's
+        expectations riding the heartbeat response. This is the repair
+        path for replicas that missed a /field_index fan-out — an alive
+        node that hit a transient RPC failure, or one that restarted
+        from a local schema.json persisted before the change."""
+        for pid_s, flags in expect.items():
+            eng = self.engines.get(int(pid_s))
+            if eng is None:
+                continue
+            for f in eng.schema.fields:
+                if f.data_type is DataType.VECTOR:
+                    continue
+                desired = flags.get(f.name, "NONE")
+                if f.scalar_index.value != desired:
+                    eng.add_field_index(f.name, desired)
 
     # -- recovery (reference: partition_service.go:275 recoverPartitions:
     #    re-Build engine, gamma Load, rejoin raft) ---------------------------
@@ -826,6 +852,21 @@ class PSServer:
         eng = self._engine(body["partition_id"])
         eng.build_index()
         return {"status": int(eng.status)}
+
+    def _h_field_index(self, body: dict, _parts) -> dict:
+        """Master fan-out target for online scalar field-index add/remove
+        (reference: gammacb/gamma.go:538,591 — the PS seam that hands
+        AddFieldIndex/RemoveFieldIndex to the engine)."""
+        eng = self._engine(body["partition_id"])
+        itype = str(body.get("index_type", "INVERTED")).upper()
+        if itype == "NONE":
+            eng.remove_field_index(body["field"])
+        else:
+            eng.add_field_index(
+                body["field"], itype,
+                background=bool(body.get("background", True)),
+            )
+        return {"field": body["field"], "index_type": itype}
 
     def _h_rebuild(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])

@@ -84,6 +84,7 @@ class RouterServer:
             s.route(method, "/alias", self._proxy_master(method, "/alias"))
         s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
         s.route("POST", "/partitions/rule", self._h_partition_rule)
+        s.route("POST", "/field_index", self._h_field_index)
         s.route("GET", "/cluster/health", self._h_health)
         s.route("GET", "/router/stats", self._h_router_stats)
         s.tracer = self.tracer  # serves GET /debug/traces
@@ -358,6 +359,13 @@ class RouterServer:
         out = self._master_call("POST", "/partitions/rule", body)
         # topology changed (groups added/dropped): serving from the TTL
         # cache would fan out to deleted partitions
+        self._invalidate_caches()
+        return out
+
+    def _h_field_index(self, body, _parts) -> dict:
+        out = self._master_call("POST", "/field_index", body)
+        # schema changed (field gained/lost a scalar index): refresh so
+        # filter planning sees the new index flags promptly
         self._invalidate_caches()
         return out
 

@@ -101,6 +101,12 @@ class Engine:
         self.indexes: dict[str, VectorIndex] = {}
         self.status = IndexStatus.UNINDEXED
         self._write_lock = threading.Lock()
+        # field -> (target index type, done event) for builds in flight;
+        # stops the heartbeat reconcile loop re-spawning a build every 2s
+        # while a long background build has yet to publish (flags only
+        # flip at publish time), and lets sync callers join an identical
+        # in-flight build
+        self._field_builds: dict[str, tuple[str, threading.Event]] = {}
         # query micro-batching (engine/microbatch.py): lazily started on
         # the first qualifying search so idle engines spawn no thread
         self.micro_batch = True
@@ -394,6 +400,22 @@ class Engine:
         itype = ScalarIndexType(index_type.upper())
         if itype is ScalarIndexType.NONE:
             return self.remove_field_index(field)
+        with self._write_lock:
+            cur = self._field_builds.get(field)
+            if cur is not None and cur[0] == itype.value:
+                if not background:
+                    # sync contract: the index must be live on return,
+                    # even when an identical build is already in flight
+                    pending = cur[1]
+                else:
+                    return  # identical background build already in flight
+            else:
+                pending = None
+                done = threading.Event()
+                self._field_builds[field] = (itype.value, done)
+        if pending is not None:
+            pending.wait()
+            return
 
         def build() -> None:
             from vearch_tpu.scalar.manager import _NUMERIC
@@ -439,14 +461,27 @@ class Engine:
                 self._scalar_manager.add_field(field, index)
                 f.scalar_index = itype  # dumps persist the new schema
 
+        def run() -> None:
+            try:
+                build()
+            finally:
+                with self._write_lock:
+                    # pop only OUR marker: an overlapping build of a
+                    # different type replaced it, and erasing that one
+                    # would let the heartbeat reconcile spawn duplicates
+                    cur = self._field_builds.get(field)
+                    if cur is not None and cur[1] is done:
+                        self._field_builds.pop(field)
+                done.set()
+
         if background:
             t = threading.Thread(
-                target=build, daemon=True,
+                target=run, daemon=True,
                 name=f"vearch-field-index-{field}",
             )
             t.start()
         else:
-            build()
+            run()
 
     def remove_field_index(self, field: str) -> None:
         """Drop a field's scalar index; in-flight filtered searches fall
@@ -763,10 +798,16 @@ class Engine:
         name = f"seg_{start:010d}_{end:010d}"
         final = os.path.join(dirpath, "segments", name)
         tmp = final + ".tmp"
-        if os.path.isdir(tmp):
-            import shutil
+        import shutil
 
+        if os.path.isdir(tmp):
             shutil.rmtree(tmp)
+        if os.path.isdir(final):
+            # orphan from a crash between os.replace and the manifest
+            # commit: rows are immutable, so a same-boundary segment has
+            # identical content — but os.replace cannot rename onto a
+            # non-empty dir, so drop it or every later dump wedges
+            shutil.rmtree(final)
         os.makedirs(tmp)
         tsnap = snap["table"]
         np.savez(

@@ -151,12 +151,15 @@ class RawVectorStore:
         format; Engine.load concatenates MANIFEST segments)."""
         if not paths:
             return
-        parts = [np.load(p) for p in paths]
+        parts = [np.load(p, mmap_mode="r") for p in paths]
         n = sum(p.shape[0] for p in parts)
         host = np.zeros((max(n, 1024), self.dimension), dtype=np.float32)
         off = 0
+        chunk = 1 << 18  # stream from the mmap; never double peak RAM
         for p in parts:
-            host[off : off + p.shape[0]] = p
+            for lo in range(0, p.shape[0], chunk):
+                hi = min(lo + chunk, p.shape[0])
+                host[off + lo : off + hi] = p[lo:hi]
             off += p.shape[0]
         self._host = host
         self._n = n

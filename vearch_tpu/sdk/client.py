@@ -146,3 +146,25 @@ class VearchClient:
     def rebuild(self, db_name: str, space_name: str) -> dict:
         return rpc.call(self.addr, "POST", "/index/rebuild",
                         {"db_name": db_name, "space_name": space_name})
+
+    def add_field_index(
+        self, db_name: str, space_name: str, field: str,
+        index_type: str = "INVERTED", background: bool = True,
+    ) -> dict:
+        """Build a scalar index on a live field (reference:
+        AddFieldIndexWithParams, c_api/gamma_api.h:166)."""
+        return rpc.call(self.addr, "POST", "/field_index", {
+            "db_name": db_name, "space_name": space_name, "field": field,
+            "operator_type": "ADD", "index_type": index_type,
+            "background": background,
+        })
+
+    def remove_field_index(
+        self, db_name: str, space_name: str, field: str
+    ) -> dict:
+        """Drop a field's scalar index (reference: RemoveFieldIndex,
+        c_api/gamma_api.h:181)."""
+        return rpc.call(self.addr, "POST", "/field_index", {
+            "db_name": db_name, "space_name": space_name, "field": field,
+            "operator_type": "DROP",
+        })
