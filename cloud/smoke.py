@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Cluster-profile smoke suite: the process-level equivalent of the
+reference's cluster CI (reference: .github/workflows/CI_cluster.yml:33-51
+runs pytest against the docker-compose fabric of 3 masters + routers +
+PSes + MinIO, with failure injection).
+
+One command brings up the full topology as REAL subprocesses of
+`python -m vearch_tpu` — 3 metadata-raft masters, 2 routers, 3 partition
+servers — plus an in-process S3 endpoint, then asserts:
+
+  1. replicated writes + search through BOTH routers;
+  2. master leader kill -9 -> API keeps serving via the survivors;
+  3. S3 backup -> delete-all -> restore round-trip;
+  4. PS kill -9 -> replicated partition keeps serving reads.
+
+Exit 0 = all green. Run: `python cloud/smoke.py` (CPU-only, ~2 min).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from vearch_tpu.cluster import rpc  # noqa: E402
+from vearch_tpu.cluster.rpc import RpcError  # noqa: E402
+from vearch_tpu.sdk.client import VearchClient  # noqa: E402
+from tests.test_objectstore_s3 import MockS3  # noqa: E402
+
+D = 8
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn(args: list[str]) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "vearch_tpu", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=ENV, cwd=REPO,
+    )
+
+
+def wait_http(addr: str, deadline: float = 60.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < deadline:
+        try:
+            rpc.call(addr, "GET", "/", timeout=2.0)
+            return
+        except RpcError:
+            time.sleep(0.3)
+    raise SystemExit(f"FAIL: {addr} did not come up")
+
+
+def main(data_dir: str) -> int:
+    mports = free_ports(3)
+    rports = free_ports(2)
+    peers = ",".join(f"{i + 1}=127.0.0.1:{p}" for i, p in enumerate(mports))
+    master_list = ",".join(f"127.0.0.1:{p}" for p in mports)
+    procs: dict[str, subprocess.Popen] = {}
+    s3 = MockS3()
+    try:
+        for i, p in enumerate(mports):
+            procs[f"master{i + 1}"] = spawn([
+                "--role", "master", "--port", str(p),
+                "--data-dir", f"{data_dir}/m{i + 1}",
+                "--node-id", str(i + 1), "--peers", peers,
+            ])
+        for addr in master_list.split(","):
+            wait_http(addr)
+        for i in range(3):
+            procs[f"ps{i + 1}"] = spawn([
+                "--role", "ps", "--data-dir", f"{data_dir}/ps{i + 1}",
+                "--master-addr", master_list,
+            ])
+        for i, p in enumerate(rports):
+            procs[f"router{i + 1}"] = spawn([
+                "--role", "router", "--port", str(p),
+                "--master-addr", master_list,
+            ])
+        r1 = VearchClient(f"127.0.0.1:{rports[0]}")
+        r2 = VearchClient(f"127.0.0.1:{rports[1]}")
+        t0 = time.time()
+        while not (r1.is_live() and r2.is_live()):
+            if time.time() - t0 > 60:
+                raise SystemExit("FAIL: routers never went live")
+            time.sleep(0.5)
+        # all 3 PS registered?
+        t0 = time.time()
+        while len(rpc.call(master_list, "GET", "/servers")["servers"]) < 3:
+            if time.time() - t0 > 60:
+                raise SystemExit("FAIL: <3 PS registered")
+            time.sleep(0.5)
+
+        # 1. replicated space, writes via r1, reads via r2
+        r1.create_database("db")
+        r1.create_space("db", {
+            "name": "s", "partition_num": 3, "replica_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        rng = np.random.default_rng(0)
+        vecs = rng.standard_normal((120, D)).astype(np.float32)
+        r1.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(120)])
+        hits = r2.search("db", "s", [{"field": "v", "feature": vecs[7]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d7", hits
+        print("smoke 1 OK: replicated writes, cross-router reads")
+
+        # 2. kill -9 the master leader; the API must keep serving
+        leader = None
+        for name in ("master1", "master2", "master3"):
+            idx = int(name[-1]) - 1
+            st = rpc.call(f"127.0.0.1:{mports[idx]}", "GET", "/")
+            if st.get("meta_leader"):
+                leader = name
+                break
+        leader = leader or "master1"
+        procs[leader].send_signal(signal.SIGKILL)
+        procs[leader].wait()
+        t0 = time.time()
+        while True:
+            try:
+                r2.get_space("db", "s")
+                break
+            except RpcError:
+                if time.time() - t0 > 60:
+                    raise SystemExit(
+                        "FAIL: API dead after master leader kill")
+                time.sleep(0.5)
+        hits = r1.search("db", "s", [{"field": "v", "feature": vecs[9]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d9"
+        # mutations need a NEW metadata leader elected among survivors
+        t0 = time.time()
+        while True:
+            leads = []
+            for name, proc in procs.items():
+                if not name.startswith("master") or proc.poll() is not None:
+                    continue
+                idx = int(name[-1]) - 1
+                try:
+                    st = rpc.call(f"127.0.0.1:{mports[idx]}", "GET", "/",
+                                  timeout=2.0)
+                    leads.append(bool(st.get("meta_leader")))
+                except RpcError:
+                    pass
+            if any(leads):
+                break
+            if time.time() - t0 > 60:
+                raise SystemExit("FAIL: no new metadata leader elected")
+            time.sleep(0.5)
+        print(f"smoke 2 OK: {leader} killed, survivors re-elected + serve")
+
+        # 3. S3 backup -> wipe -> restore
+        spec = {"type": "s3", "endpoint": s3.addr, "bucket": "bk",
+                "access_key": "ak", "secret_key": "sk"}
+        out = rpc.call(master_list, "POST", "/backup/dbs/db/spaces/s",
+                       {"command": "create", "store": spec})
+        assert out["version"] == 1, out
+        r1.delete("db", "s", document_ids=[f"d{i}" for i in range(120)])
+        assert r1.search("db", "s", [{"field": "v", "feature": vecs[7]}],
+                         limit=1)[0] == []
+        out = rpc.call(master_list, "POST", "/backup/dbs/db/spaces/s",
+                       {"command": "restore", "store": spec, "version": 1})
+        assert sum(p["doc_count"] for p in out["partitions"]) == 120
+        hits = r2.search("db", "s", [{"field": "v", "feature": vecs[7]}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d7"
+        print("smoke 3 OK: S3 backup/restore round-trip")
+
+        # 4. kill -9 one PS; replica_num=2 keeps every partition served
+        procs["ps1"].send_signal(signal.SIGKILL)
+        procs["ps1"].wait()
+        t0 = time.time()
+        while True:
+            try:
+                hits = r1.search("db", "s",
+                                 [{"field": "v", "feature": vecs[11]}],
+                                 limit=1)
+                if hits[0] and hits[0][0]["_id"] == "d11":
+                    break
+            except RpcError:
+                pass
+            if time.time() - t0 > 90:
+                raise SystemExit("FAIL: search dead after PS kill")
+            time.sleep(0.5)
+        print("smoke 4 OK: PS killed, replicas keep serving")
+        print("CLUSTER SMOKE: ALL GREEN")
+        return 0
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        s3.stop()
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="vearch_smoke_") as d:
+        sys.exit(main(d))

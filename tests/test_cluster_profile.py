@@ -1,0 +1,23 @@
+"""Cluster CI profile: runs cloud/smoke.py — the full 3-master/2-router/
+3-PS + S3 topology as real subprocesses, with leader and PS kill -9
+failure injection (reference: CI_cluster.yml:33-51 runs its suite
+against the compose fabric)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_cluster_smoke_profile():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "cloud", "smoke.py")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+    assert out.returncode == 0, f"smoke failed:\n{out.stdout}\n{out.stderr}"
+    assert "CLUSTER SMOKE: ALL GREEN" in out.stdout

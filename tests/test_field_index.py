@@ -196,6 +196,79 @@ def test_heartbeat_reconciles_missed_fanout(tmp_path):
         master.stop()
 
 
+def test_remove_cancels_inflight_build():
+    """A remove during a long background build must win: the stale build
+    must not publish after the drop (reviewer-found publish race)."""
+    import threading
+
+    from vearch_tpu.engine.engine import Engine
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, ScalarIndexType,
+        TableSchema,
+    )
+
+    schema = TableSchema("t", [
+        FieldSchema("color", DataType.STRING),
+        FieldSchema("v", DataType.VECTOR, dimension=4,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": f"d{i}", "color": "red", "v": [0.0] * 4}
+                for i in range(50)])
+
+    # stall the build inside its lock-free bulk phase so the remove can
+    # interleave before publish
+    gate = threading.Event()
+    orig_column = eng.table.column
+
+    def slow_column(name):
+        if name == "color":
+            gate.wait(5)
+        return orig_column(name)
+
+    eng.table.column = slow_column
+    eng.add_field_index("color", "BITMAP")  # background
+    assert "color" in eng._field_builds
+    eng.remove_field_index("color")  # cancels the marker
+    gate.set()
+    # the build thread finishes; its publish must have been refused
+    deadline = time.time() + 5
+    while time.time() < deadline and "color" in eng._field_builds:
+        time.sleep(0.05)
+    assert eng._scalar_manager is None or \
+        not eng._scalar_manager.has_index("color")
+    assert eng.schema.field("color").scalar_index is ScalarIndexType.NONE
+    eng.table.column = orig_column
+
+    # sync join of a failed build must raise, not report success
+    def boom(name):
+        raise RuntimeError("column store exploded")
+
+    eng.table.column = boom
+    eng.table.string_column = boom
+    with pytest.raises(RuntimeError):
+        eng.add_field_index("color", "INVERTED", background=False)
+
+
+def test_enable_id_cache_round_trips(client):
+    """`enable_id_cache` (reference: entity/space.go:88-94) round-trips
+    the space API; the engine's key->docid map is in-process so the
+    cache is structurally always-on — the flag is wire compat."""
+    client.create_space("db", {
+        "name": "idc", "partition_num": 1, "replica_num": 1,
+        "enable_id_cache": False,
+        "fields": [
+            {"name": "emb", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    sp = client.get_space("db", "idc")
+    assert sp["enable_id_cache"] is False
+    sp2 = client.get_space("db", "sp")  # default true, omitted from dict
+    assert sp2.get("enable_id_cache", True) is True
+
+
 def test_numeric_inverted_index_supports_range(cluster, client):
     client.add_field_index("db", "sp", "price", "INVERTED",
                            background=False)

@@ -1,9 +1,11 @@
 """Per-index recall gates against exact ground truth (reference:
 test/test_recall_baseline.py:301-303 — recall@100 >= 0.9, @10 >= 0.8,
 @1 >= 0.5, gated per index type on real datasets vs an in-process faiss
-oracle; this image has zero egress, so the dataset is the same
-clustered-Gaussian SIFT-like generator bench.py uses and the oracle is
-an exact numpy scan — the gate thresholds are the reference's own)."""
+oracle; this image has zero egress, so the data comes from
+tests/datasets.py: an easy isotropic clustered-Gaussian regime AND a
+hard regime — power-law cluster masses, anisotropic covariance, OOD
+queries — built to reproduce what makes SIFT/Glove/Nytimes hard. The
+gate thresholds are the reference's own, enforced on BOTH regimes."""
 
 import numpy as np
 import pytest
@@ -12,6 +14,7 @@ from vearch_tpu.engine.engine import Engine, SearchRequest
 from vearch_tpu.engine.types import (
     DataType, FieldSchema, IndexParams, MetricType, TableSchema,
 )
+from tests.datasets import make_easy, make_gist_like, make_hard
 
 N, D, NQ = 30_000, 64, 64
 
@@ -19,39 +22,35 @@ R_AT_100 = 0.9
 R_AT_10 = 0.8
 R_AT_1 = 0.5
 
+# {(index_name, regime): {k: recall}} — printed by test_zz_recall_matrix
+RESULTS: dict[tuple[str, str], dict[int, float]] = {}
+
 
 @pytest.fixture(scope="module")
-def dataset():
-    rng = np.random.default_rng(7)
-    nc = 300
-    centers = (rng.standard_normal((nc, D)) * 3).astype(np.float32)
-    which = rng.integers(0, nc, N)
-    base = centers[which] + 0.7 * rng.standard_normal((N, D)).astype(
-        np.float32
-    )
-    q_idx = rng.choice(N, NQ, replace=False)
-    queries = base[q_idx] + 0.1 * rng.standard_normal((NQ, D)).astype(
-        np.float32
-    )
-    # exact L2 ground truth (the oracle): full f64 scan
-    d2 = (
-        np.sum(queries.astype(np.float64) ** 2, axis=1)[:, None]
-        - 2.0 * queries.astype(np.float64) @ base.astype(np.float64).T
-        + np.sum(base.astype(np.float64) ** 2, axis=1)[None, :]
-    )
-    gt = np.argsort(d2, axis=1)[:, :100]
-    return base, queries, gt
+def easy():
+    return make_easy(N, D, NQ)
+
+
+@pytest.fixture(scope="module")
+def hard():
+    return make_hard(N, D, NQ)
+
+
+@pytest.fixture(scope="module")
+def regimes(easy, hard):
+    return {"easy": easy, "hard": hard}
 
 
 def build_engine(index_params: IndexParams, base: np.ndarray) -> Engine:
     schema = TableSchema("r", [
-        FieldSchema("v", DataType.VECTOR, dimension=D, index=index_params),
+        FieldSchema("v", DataType.VECTOR, dimension=base.shape[1],
+                    index=index_params),
     ])
     eng = Engine(schema)
     step = 10_000
-    for i in range(0, N, step):
+    for i in range(0, len(base), step):
         eng.upsert([{"_id": str(j), "v": base[j]}
-                    for j in range(i, i + step)])
+                    for j in range(i, min(i + step, len(base)))])
     eng.build_index()
     return eng
 
@@ -70,67 +69,108 @@ def recalls(eng: Engine, queries, gt, index_params=None):
     return out
 
 
-def assert_gates(r, name):
-    assert r[100] >= R_AT_100, f"{name} recall@100 {r[100]:.3f} < {R_AT_100}"
-    assert r[10] >= R_AT_10, f"{name} recall@10 {r[10]:.3f} < {R_AT_10}"
-    assert r[1] >= R_AT_1, f"{name} recall@1 {r[1]:.3f} < {R_AT_1}"
-
-
-def test_recall_flat(dataset):
+def gate(eng, dataset, name, regime, index_params=None):
     base, queries, gt = dataset
+    r = recalls(eng, queries, gt, index_params)
+    RESULTS[(name, regime)] = r
+    assert r[100] >= R_AT_100, \
+        f"{name}/{regime} recall@100 {r[100]:.3f} < {R_AT_100}"
+    assert r[10] >= R_AT_10, \
+        f"{name}/{regime} recall@10 {r[10]:.3f} < {R_AT_10}"
+    assert r[1] >= R_AT_1, \
+        f"{name}/{regime} recall@1 {r[1]:.3f} < {R_AT_1}"
+    return r
+
+
+REGIMES = ("easy", "hard")
+
+
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_flat(regimes, regime):
+    base, queries, gt = regimes[regime]
     eng = build_engine(IndexParams("FLAT", MetricType.L2, {}), base)
     r = recalls(eng, queries, gt)
-    # exact index: hold it to far above the generic gates
+    RESULTS[("FLAT", regime)] = r
+    # exact index: hold it to far above the generic gates on BOTH regimes
     assert r[1] >= 0.99 and r[10] >= 0.99, r
 
 
-def test_recall_ivfflat(dataset):
-    base, queries, gt = dataset
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_ivfflat(regimes, regime):
+    base, queries, gt = regimes[regime]
+    # nprobe 32 (not the easy-set 24): power-law cluster masses put many
+    # true neighborhoods in tail cells — the reference runs SIFT1M at
+    # comparable probe fractions (nprobe/ncentroids)
     eng = build_engine(IndexParams("IVFFLAT", MetricType.L2, {
-        "ncentroids": 128, "nprobe": 24, "train_iters": 6,
+        "ncentroids": 128, "nprobe": 32, "train_iters": 6,
         "training_threshold": N,
     }), base)
-    assert_gates(recalls(eng, queries, gt), "IVFFLAT")
+    gate(eng, regimes[regime], "IVFFLAT", regime)
 
 
-def test_recall_ivfpq_full_scan(dataset):
-    base, queries, gt = dataset
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_ivfpq_full_scan(regimes, regime):
+    base, queries, gt = regimes[regime]
     eng = build_engine(IndexParams("IVFPQ", MetricType.L2, {
         "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
         "training_threshold": N,
     }), base)
-    assert_gates(
-        recalls(eng, queries, gt, {"rerank": 256}), "IVFPQ/full"
-    )
+    gate(eng, regimes[regime], "IVFPQ/full", regime, {"rerank": 256})
 
 
-def test_recall_ivfpq_probe_mode(dataset):
-    base, queries, gt = dataset
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_ivfpq_probe_mode(regimes, regime):
+    base, queries, gt = regimes[regime]
     eng = build_engine(IndexParams("IVFPQ", MetricType.L2, {
         "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
-        "training_threshold": N, "scan_mode": "probe", "nprobe": 24,
+        "training_threshold": N, "scan_mode": "probe", "nprobe": 32,
     }), base)
-    assert_gates(
-        recalls(eng, queries, gt, {"rerank": 256}), "IVFPQ/probe"
-    )
+    gate(eng, regimes[regime], "IVFPQ/probe", regime, {"rerank": 256})
 
 
-def test_recall_hnsw_surface(dataset):
-    base, queries, gt = dataset
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_hnsw_surface(regimes, regime):
+    base, queries, gt = regimes[regime]
     eng = build_engine(IndexParams("HNSW", MetricType.L2, {
-        "nlinks": 32, "efSearch": 64, "training_threshold": N,
+        "nlinks": 32, "efSearch": 128, "training_threshold": N,
     }), base)
-    assert_gates(recalls(eng, queries, gt), "HNSW")
+    gate(eng, regimes[regime], "HNSW", regime)
 
 
-def test_recall_ivfrabitq(dataset):
-    base, queries, gt = dataset
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_ivfrabitq(regimes, regime):
+    base, queries, gt = regimes[regime]
     eng = build_engine(IndexParams("IVFRABITQ", MetricType.L2, {
         "ncentroids": 128, "train_iters": 6, "training_threshold": N,
     }), base)
-    assert_gates(
-        recalls(eng, queries, gt, {"rerank": 512}), "IVFRABITQ"
-    )
+    gate(eng, regimes[regime], "IVFRABITQ", regime, {"rerank": 768})
+
+
+@pytest.mark.parametrize("regime", REGIMES)
+def test_recall_scann(regimes, regime):
+    base, queries, gt = regimes[regime]
+    eng = build_engine(IndexParams("SCANN", MetricType.INNER_PRODUCT, {
+        "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
+        "training_threshold": N, "nprobe": 32,
+    }), base)
+    # SCANN optimizes inner-product ranking; gate it on MIPS ground
+    # truth, not the module's L2 oracle
+    q = queries.astype(np.float64)
+    ip = q @ base.astype(np.float64).T
+    gt_ip = np.argsort(-ip, axis=1)[:, :100]
+    gate(eng, (base, queries, gt_ip), "SCANN", regime, {"rerank": 256})
+
+
+def test_recall_gist_like_ivfpq():
+    """d=960 GIST-shaped config (low intrinsic dimension, strongly
+    correlated subspaces — the regime where PQ subquantizers are
+    stressed; BASELINE.json lists GIST1M as a reference target)."""
+    base, queries, gt = make_gist_like()
+    eng = build_engine(IndexParams("IVFPQ", MetricType.L2, {
+        "ncentroids": 64, "nsubvector": 32, "train_iters": 6,
+        "training_threshold": len(base),
+    }), base)
+    gate(eng, (base, queries, gt), "IVFPQ/gist960", "gist")
 
 
 def test_recall_binaryivf():
@@ -178,14 +218,15 @@ def test_recall_binaryivf():
         for q in range(nq)
     ]))
     r1 = float(np.mean([got[q][0] == gt[q][0] for q in range(nq)]))
+    RESULTS[("BINARYIVF", "binary")] = {1: r1, 10: r10, 100: float("nan")}
     assert r10 >= R_AT_10, f"BINARYIVF recall@10 {r10:.3f}"
     assert r1 >= R_AT_1, f"BINARYIVF recall@1 {r1:.3f}"
 
 
-def test_recall_ivfpq_opq(dataset):
+def test_recall_ivfpq_opq(regimes):
     """OPQ rotation (reference: gamma_index_ivfpq.h opq_ option) meets
     the gates and does not lose recall vs plain PQ on the same data."""
-    base, queries, gt = dataset
+    base, queries, gt = regimes["easy"]
     params = {
         "ncentroids": 128, "nsubvector": 16, "train_iters": 6,
         "training_threshold": N,
@@ -201,7 +242,9 @@ def test_recall_ivfpq_opq(dataset):
     # candidate set no matter the rerank depth).
     r_plain = recalls(plain, queries, gt, {"rerank": 128})
     r_opq = recalls(opq, queries, gt, {"rerank": 128})
-    assert_gates(r_opq, "IVFPQ/OPQ")
+    RESULTS[("IVFPQ/OPQ", "easy")] = r_opq
+    assert r_opq[100] >= R_AT_100 and r_opq[10] >= R_AT_10 \
+        and r_opq[1] >= R_AT_1, r_opq
     # OPQ refines the quantizer (measured: mirror MSE 0.2815 vs 0.2905
     # plain at these params) but per-build k-means variance swings
     # recall@10 by a few points either way — compare with slack
@@ -215,3 +258,18 @@ def test_recall_ivfpq_opq(dataset):
         eng2 = Engine.open(tmp)
         r2 = recalls(eng2, queries, gt, {"rerank": 128})
         assert abs(r2[10] - r_opq[10]) < 0.05
+
+
+def test_zz_recall_matrix():
+    """Prints the {index} x {regime} recall matrix accumulated by the
+    gates above (run with -s to see it; VERDICT r2 #3 asks for the
+    matrix printed per round)."""
+    rows = sorted(RESULTS)
+    if not rows:
+        pytest.skip("gate tests deselected (or running on another "
+                    "xdist worker); nothing to report")
+    print("\nrecall matrix (r@1 / r@10 / r@100):")
+    for name, regime in rows:
+        r = RESULTS[(name, regime)]
+        print(f"  {name:<14} {regime:<7} "
+              f"{r[1]:.3f} / {r[10]:.3f} / {r.get(100, float('nan')):.3f}")

@@ -68,6 +68,129 @@ def _fetch_traces(addr: str, trace_id: str) -> list[dict]:
         return json.loads(r.read())["spans"]
 
 
+class _MockCollector:
+    """Stdlib OTLP/HTTP collector: records POST /v1/traces bodies."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        collector = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                collector.batches.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self.batches: list[dict] = []
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.endpoint = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def spans(self) -> list[dict]:
+        out = []
+        for b in self.batches:
+            for rs in b.get("resourceSpans", []):
+                svc = next((
+                    a["value"]["stringValue"]
+                    for a in rs["resource"]["attributes"]
+                    if a["key"] == "service.name"), "?")
+                for ss in rs.get("scopeSpans", []):
+                    for s in ss.get("spans", []):
+                        out.append({**s, "service": svc})
+        return out
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_otlp_exporter_ships_span_tree(tmp_path, rng):
+    """A real collector endpoint receives a correctly-parented
+    router->PS span tree as OTLP/HTTP JSON (VERDICT r2 #7; reference
+    ships the same tree to jaeger-agent, startup.go:66-85)."""
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+    from vearch_tpu.sdk.client import VearchClient
+    import vearch_tpu.cluster.rpc as rpc
+
+    col = _MockCollector()
+    master = MasterServer()
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "tr"), master_addr=master.addr,
+                  trace_collector=col.endpoint)
+    ps.start()
+    router = RouterServer(master_addr=master.addr,
+                          trace_collector=col.endpoint)
+    router.start()
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("t")
+        cl.create_space("t", {
+            "name": "s", "partition_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": 16,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((30, 16)).astype(np.float32)
+        cl.upsert("t", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                             for i in range(30)])
+        out = rpc.call(router.addr, "POST", "/document/search", {
+            "db_name": "t", "space_name": "s",
+            "vectors": [{"field": "v", "feature": vecs[3].tolist()}],
+            "limit": 3, "trace": True,
+        })
+        tid = out["trace_id"]
+        router.tracer.exporter.flush()
+        ps.tracer.exporter.flush()
+
+        got = [s for s in col.spans() if s["traceId"] == tid]
+        names = {s["name"] for s in got}
+        assert "router.search" in names and "ps.search" in names, names
+        root = next(s for s in got if s["name"] == "router.search")
+        assert root["parentSpanId"] == ""  # true root
+        scatter = [s for s in got if s["name"] == "router.scatter"]
+        assert len(scatter) == 2
+        for s in scatter:
+            assert s["service"] == "router"
+            assert s["parentSpanId"] == root["spanId"]
+        scatter_ids = {s["spanId"] for s in scatter}
+        ps_spans = [s for s in got if s["service"] == "ps"]
+        assert len(ps_spans) == 2
+        for s in ps_spans:
+            assert s["parentSpanId"] in scatter_ids | {root["spanId"]}
+            # OTLP shape essentials survive the wire
+            assert len(s["traceId"]) == 32 and len(s["spanId"]) == 16
+            assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+            assert s["status"]["code"] == 1
+        assert router.tracer.exporter.exported >= 3
+        assert router.tracer.exporter.dropped == 0
+    finally:
+        router.stop()
+        ps.stop()
+        master.stop()
+        col.close()
+
+
+def test_otlp_exporter_survives_dead_collector():
+    """A dead collector must cost dropped batches, never request-path
+    errors or blocking."""
+    tr = Tracer("svc", collector_endpoint="http://127.0.0.1:9")  # closed
+    with tr.span("a"):
+        pass
+    tr.exporter.flush()
+    assert tr.exporter.dropped == 1
+    assert tr.spans()[0]["name"] == "a"  # ring store unaffected
+
+
 def test_cluster_span_propagation(tmp_path, rng):
     """trace:true search produces a linked span tree across router and
     PS processes, queryable per role."""

@@ -29,9 +29,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="host:port of the master (ps/router roles)")
     ap.add_argument("--data-dir", default="./vearch_data")
     ap.add_argument("--auth", action="store_true")
+    ap.add_argument("--grpc-port", type=int, default=None,
+                    help="router only: serve gRPC next to HTTP "
+                         "(reference: router rpc_port)")
     ap.add_argument("--root-password", default="secret")
     ap.add_argument("--n-ps", type=int, default=1,
                     help="partition servers in standalone mode")
+    ap.add_argument("--node-id", type=int, default=1,
+                    help="master only: this replica's id in a "
+                         "multi-master metadata raft")
+    ap.add_argument("--peers", default=None,
+                    help="master only: multimaster peer map, "
+                         "'1=host:port,2=host:port,...' (reference: "
+                         "embedded-etcd initial-cluster)")
     args = ap.parse_args(argv)
 
     from vearch_tpu.utils import log
@@ -77,10 +87,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.role == "master":
         from vearch_tpu.cluster.master import MasterServer
 
+        peers = None
+        if args.peers:
+            peers = {}
+            for part in args.peers.split(","):
+                nid, _, addr = part.strip().partition("=")
+                peers[int(nid)] = addr
         server = MasterServer(
             host=args.host, port=args.port,
             persist_path=f"{args.data_dir}/meta.json",
             auth=args.auth, root_password=args.root_password,
+            node_id=args.node_id, peers=peers,
+            meta_dir=args.data_dir if peers else None,
         )
         server.start()
         print(f"master: http://{server.addr}", flush=True)
@@ -96,15 +114,20 @@ def main(argv: list[str] | None = None) -> int:
         from vearch_tpu.cluster.ps import PSServer
 
         cfg_ps = {}
+        cfg_tr = {}
         if args.conf:
             from vearch_tpu.cluster.config import Config
 
-            cfg_ps = getattr(Config.load(args.conf), "ps", {}) or {}
+            cfg = Config.load(args.conf)
+            cfg_ps = getattr(cfg, "ps", {}) or {}
+            cfg_tr = getattr(cfg, "tracer", {}) or {}
         server = PSServer(
             data_dir=args.data_dir, host=args.host, port=args.port,
             master_addr=args.master_addr,
             master_auth=("root", args.root_password) if args.auth else None,
             backup_roots=cfg_ps.get("backup_roots"),
+            backup_endpoints=cfg_ps.get("backup_endpoints"),
+            trace_collector=cfg_tr.get("collector_endpoint"),
         )
         server.start()
         print(f"ps node {server.node_id}: http://{server.addr}", flush=True)
@@ -126,9 +149,13 @@ def main(argv: list[str] | None = None) -> int:
         # reference: [tracer] config block (sampler rate), startup.go:66
         trace_sample=float(cfg_tr.get("sample_rate", 0.0)),
         trace_export=cfg_tr.get("export_path"),
+        trace_collector=cfg_tr.get("collector_endpoint"),
+        grpc_port=args.grpc_port,
     )
     server.start()
     print(f"router: http://{server.addr}", flush=True)
+    if server.grpc is not None:
+        print(f"router grpc: {server.grpc.addr}", flush=True)
     stop.wait()
     server.stop()
     return 0

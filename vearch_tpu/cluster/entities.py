@@ -64,6 +64,11 @@ class Space:
     # replica placement anti-affinity: none|host|rack|zone (reference:
     # config.go:389 strategies 0-3)
     anti_affinity: str = "none"
+    # id->docid cache toggle (reference: entity/space.go:88-94). Kept
+    # for wire compat: this engine holds the key->docid map in-process
+    # (table.py _key_to_docid — no FFI boundary to cache across), so the
+    # cache is structurally always-on; the flag round-trips the API.
+    enable_id_cache: bool = True
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -79,6 +84,8 @@ class Space:
             d["partition_rule"] = self.partition_rule
         if self.anti_affinity != "none":
             d["anti_affinity"] = self.anti_affinity
+        if not self.enable_id_cache:
+            d["enable_id_cache"] = False
         return d
 
     @classmethod
@@ -93,6 +100,7 @@ class Space:
             partitions=[Partition.from_dict(p) for p in d.get("partitions", [])],
             partition_rule=d.get("partition_rule"),
             anti_affinity=d.get("anti_affinity", "none"),
+            enable_id_cache=bool(d.get("enable_id_cache", True)),
         )
 
     def slot_starts(self) -> list[int]:

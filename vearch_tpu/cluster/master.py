@@ -874,6 +874,10 @@ class MasterServer:
             "name": "vearch-tpu",
             "version": "0.1.0",
             "status": "green" if self._alive_servers() else "yellow",
+            # which master answered, and whether it currently leads the
+            # metadata raft (ops + the cluster smoke profile use this)
+            "node_id": self.node_id,
+            "meta_leader": self.is_leader,
         }
 
     # -- runtime config (reference: cluster_api.go:294-307 modifySpaceConfig)
@@ -1154,6 +1158,7 @@ class MasterServer:
                 id=space_id, name=name, db_name=db, schema=schema,
                 partition_num=partition_num, replica_num=replica_num,
                 partition_rule=rule, anti_affinity=anti,
+                enable_id_cache=bool(body.get("enable_id_cache", True)),
             )
             # with a partition rule, every range backs its own group of
             # partition_num slot-sharded partitions (reference: a 3-range

@@ -54,6 +54,7 @@ class PSServer:
         flush_interval: float = 5.0,
         raft_tick: float = 0.4,
         labels: dict[str, str] | None = None,
+        trace_collector: str | None = None,
     ):
         from vearch_tpu.utils import apply_jax_platform_env
 
@@ -126,7 +127,7 @@ class PSServer:
         # spans join the router's trace via the _trace_ctx envelope
         # (reference: PS extracts span context from rpcx metadata,
         # ps/handler_document.go:123-126)
-        self.tracer = Tracer("ps")
+        self.tracer = Tracer("ps", collector_endpoint=trace_collector)
 
         self.server = JsonRpcServer(host, port)
         self.server.tracer = self.tracer
@@ -209,6 +210,8 @@ class PSServer:
         for eng in self.engines.values():
             eng.close()
         self.server.stop()
+        if self.tracer.exporter is not None:
+            self.tracer.exporter.close()  # ship the last buffered spans
 
     @property
     def addr(self) -> str:

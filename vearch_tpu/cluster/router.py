@@ -34,14 +34,20 @@ class RouterServer:
         master_auth: tuple[str, str] | None = None,
         trace_sample: float = 0.0,
         trace_export: str | None = None,
+        trace_collector: str | None = None,
+        grpc_port: int | None = None,
     ):
         from vearch_tpu.cluster.tracing import Tracer
 
         self.master_addr = master_addr
         # span tracer (reference: Jaeger init, startup.go:66; sampler
-        # rate from the [tracer] config block)
+        # rate + collector endpoint from the [tracer] config block)
         self.tracer = Tracer("router", sample_rate=trace_sample,
-                             export_path=trace_export)
+                             export_path=trace_export,
+                             collector_endpoint=trace_collector)
+        self._grpc_port = grpc_port
+        self._host = host
+        self.grpc = None
         # service-account credentials for master calls when auth is on
         self.master_auth = master_auth
         self._space_cache: dict[str, tuple[float, Space]] = {}
@@ -91,12 +97,24 @@ class RouterServer:
 
     def start(self) -> None:
         self.server.start()
+        if self._grpc_port is not None:
+            # gRPC front door next to HTTP (reference: router gRPC port,
+            # router/server.go:92); shares this router's handler stack
+            from vearch_tpu.cluster.grpc_server import GrpcRouter
+
+            self.grpc = GrpcRouter(self, host=self._host,
+                                   port=self._grpc_port)
+            self.grpc.start()
         threading.Thread(target=self._watch_loop, daemon=True,
                          name="router-watch").start()
 
     def stop(self) -> None:
         self._watch_stop.set()
+        if self.grpc is not None:
+            self.grpc.stop()
         self.server.stop()
+        if self.tracer.exporter is not None:
+            self.tracer.exporter.close()  # ship the last buffered spans
         self._pool.shutdown(wait=False)
 
     # -- watch-driven cache invalidation (reference: master_cache.go:414

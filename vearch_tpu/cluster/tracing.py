@@ -1,12 +1,15 @@
-"""Distributed tracing: spans, cross-process propagation, local store.
+"""Distributed tracing: spans, cross-process propagation, local store,
+and an OTLP-HTTP exporter to a real collector.
 
 The reference wires Jaeger/opentracing end-to-end (reference:
 cmd/vearch/startup.go:66-85 initJaeger; ps/handler_document.go:123-126
 extracts the span context from rpcx metadata; router request-id
-middleware, router/server.go:63-80). This container is zero-egress, so
-instead of shipping to a collector each process keeps a bounded ring of
-finished spans, queryable via `GET /debug/traces` on every role, with
-an optional JSONL file export in an OTLP-like shape.
+middleware, router/server.go:63-80). Each process keeps a bounded ring
+of finished spans, queryable via `GET /debug/traces` on every role, an
+optional JSONL file export, and — when `[tracer] collector_endpoint` is
+set — ships batches as OTLP/HTTP JSON (`POST {endpoint}/v1/traces`),
+the wire shape Jaeger >=1.35 and every OTel collector ingest natively
+(the modern equivalent of the reference's jaeger-agent UDP path).
 
 Propagation rides the request envelope (`_trace_ctx` in the RPC body) —
 the envelope is this framework's rpcx-metadata equivalent; handlers
@@ -77,14 +80,138 @@ class Span:
         }
 
 
+def _otlp_attr(key: str, value: Any) -> dict:
+    if isinstance(value, bool):
+        return {"key": key, "value": {"boolValue": value}}
+    if isinstance(value, int):
+        return {"key": key, "value": {"intValue": str(value)}}
+    if isinstance(value, float):
+        return {"key": key, "value": {"doubleValue": value}}
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def span_to_otlp(d: dict) -> dict:
+    """Ring-form span dict -> OTLP JSON span object."""
+    start_ns = d["start_us"] * 1000
+    return {
+        "traceId": d["trace_id"],
+        "spanId": d["span_id"],
+        "parentSpanId": d.get("parent_id") or "",
+        "name": d["name"],
+        "kind": 2,  # SPAN_KIND_SERVER
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(start_ns + d["duration_us"] * 1000),
+        "attributes": [
+            _otlp_attr(k, v) for k, v in (d.get("tags") or {}).items()
+        ],
+        "status": (
+            {"code": 1} if d.get("status") == "ok"
+            else {"code": 2, "message": str(d.get("status"))}
+        ),
+    }
+
+
+class OtlpHttpExporter:
+    """Batching OTLP/HTTP JSON shipper (stdlib urllib, background
+    thread). Export never blocks the request path: spans are queued and
+    flushed every `flush_interval` seconds or `max_batch` spans; a dead
+    collector costs a dropped batch and a counter, not latency."""
+
+    def __init__(self, endpoint: str, service: str,
+                 flush_interval: float = 2.0, max_batch: int = 512,
+                 timeout: float = 5.0):
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service = service
+        self.flush_interval = float(flush_interval)
+        self.max_batch = int(max_batch)
+        self.timeout = float(timeout)
+        self.dropped = 0
+        self.exported = 0
+        self._q: deque[dict] = deque(maxlen=8192)
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"otlp-export-{service}",
+        )
+        self._thread.start()
+
+    def export(self, span_dict: dict) -> None:
+        with self._cond:
+            if len(self._q) == self._q.maxlen:
+                self.dropped += 1  # eviction is loss too, count it
+            self._q.append(span_dict)
+            if len(self._q) >= self.max_batch:
+                self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait(self.flush_interval)
+                batch = list(self._q)
+                self._q.clear()
+                if self._stop and not batch:
+                    return
+            if batch:
+                self._send(batch)
+
+    def _send(self, batch: list[dict]) -> None:
+        import urllib.request
+
+        body = json.dumps({
+            "resourceSpans": [{
+                "resource": {"attributes": [
+                    _otlp_attr("service.name", self.service),
+                ]},
+                "scopeSpans": [{
+                    "scope": {"name": "vearch_tpu"},
+                    "spans": [span_to_otlp(d) for d in batch],
+                }],
+            }],
+        }).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+            self.exported += len(batch)
+        except Exception:
+            self.dropped += len(batch)
+
+    def flush(self) -> None:
+        """Synchronous drain for shutdown/tests (bounded by the
+        constructor's send timeout)."""
+        with self._cond:
+            batch = list(self._q)
+            self._q.clear()
+        if batch:
+            self._send(batch)
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        # the loop thread may be mid-_send with spans it already drained
+        # from the queue — join it (bounded) or shutdown kills the POST
+        self._thread.join(self.timeout + 1.0)
+        self.flush()
+
+
 class Tracer:
     """Per-process span factory + bounded finished-span store."""
 
     def __init__(self, service: str, max_spans: int = 2048,
-                 sample_rate: float = 0.0, export_path: str | None = None):
+                 sample_rate: float = 0.0, export_path: str | None = None,
+                 collector_endpoint: str | None = None):
         self.service = service
         self.sample_rate = float(sample_rate)
         self.export_path = export_path
+        self.exporter = (
+            OtlpHttpExporter(collector_endpoint, service)
+            if collector_endpoint else None
+        )
         self._spans: deque[dict] = deque(maxlen=max_spans)
         self._lock = threading.Lock()
 
@@ -105,6 +232,8 @@ class Tracer:
         d = span.to_dict()
         with self._lock:
             self._spans.append(d)
+        if self.exporter is not None:
+            self.exporter.export(d)
         if self.export_path:
             try:
                 with open(self.export_path, "a") as f:

@@ -39,6 +39,9 @@ from vearch_tpu.engine.types import (
 )
 from vearch_tpu.index.base import VectorIndex
 from vearch_tpu.index.registry import create_index
+from vearch_tpu.utils import log
+
+_log = log.get("engine")
 
 
 @dataclass
@@ -91,6 +94,18 @@ class RequestContext:
             raise RequestKilled(self.reason or "request killed")
 
 
+class _FieldBuild:
+    """In-flight scalar field-index build: target type, completion
+    event, and the build's error (read by sync joiners)."""
+
+    __slots__ = ("value", "done", "error")
+
+    def __init__(self, value: str):
+        self.value = value
+        self.done = threading.Event()
+        self.error: BaseException | None = None
+
+
 class Engine:
     def __init__(self, schema: TableSchema, data_dir: str | None = None):
         self.schema = schema
@@ -101,12 +116,12 @@ class Engine:
         self.indexes: dict[str, VectorIndex] = {}
         self.status = IndexStatus.UNINDEXED
         self._write_lock = threading.Lock()
-        # field -> (target index type, done event) for builds in flight;
-        # stops the heartbeat reconcile loop re-spawning a build every 2s
-        # while a long background build has yet to publish (flags only
-        # flip at publish time), and lets sync callers join an identical
-        # in-flight build
-        self._field_builds: dict[str, tuple[str, threading.Event]] = {}
+        # field -> in-flight build marker; stops the heartbeat reconcile
+        # loop re-spawning a build every 2s while a long background build
+        # has yet to publish (flags only flip at publish time), lets sync
+        # callers join an identical in-flight build, and gates publish on
+        # the marker still being current (supersede/remove cancels it)
+        self._field_builds: dict[str, _FieldBuild] = {}
         # query micro-batching (engine/microbatch.py): lazily started on
         # the first qualifying search so idle engines spawn no thread
         self.micro_batch = True
@@ -402,19 +417,22 @@ class Engine:
             return self.remove_field_index(field)
         with self._write_lock:
             cur = self._field_builds.get(field)
-            if cur is not None and cur[0] == itype.value:
+            if cur is not None and cur.value == itype.value:
                 if not background:
                     # sync contract: the index must be live on return,
                     # even when an identical build is already in flight
-                    pending = cur[1]
+                    pending = cur
                 else:
                     return  # identical background build already in flight
             else:
                 pending = None
-                done = threading.Event()
-                self._field_builds[field] = (itype.value, done)
+                marker = _FieldBuild(itype.value)
+                self._field_builds[field] = marker
         if pending is not None:
-            pending.wait()
+            pending.done.wait()
+            if pending.error is not None:
+                # joining must not report success for a failed build
+                raise pending.error
             return
 
         def build() -> None:
@@ -449,6 +467,11 @@ class Engine:
                         index.add(value, docid)
                 built = hi
             with self._write_lock:
+                if self._field_builds.get(field) is not marker:
+                    # superseded mid-build (a remove, or a build of a
+                    # different type): publishing now would resurrect a
+                    # dropped index or clobber the newer build
+                    return
                 # exact catch-up: rows that landed since the last pass
                 hi = self.table.doc_count
                 for docid, value in enumerate(rows(built, hi), start=built):
@@ -464,15 +487,20 @@ class Engine:
         def run() -> None:
             try:
                 build()
+            except BaseException as e:
+                marker.error = e
+                if not background:
+                    raise
+                _log.warning("background field-index build %r failed: %s",
+                             field, e)
             finally:
                 with self._write_lock:
                     # pop only OUR marker: an overlapping build of a
                     # different type replaced it, and erasing that one
                     # would let the heartbeat reconcile spawn duplicates
-                    cur = self._field_builds.get(field)
-                    if cur is not None and cur[1] is done:
+                    if self._field_builds.get(field) is marker:
                         self._field_builds.pop(field)
-                done.set()
+                marker.done.set()
 
         if background:
             t = threading.Thread(
@@ -488,6 +516,10 @@ class Engine:
         back to the columnar scan (filter.py tolerates the race)."""
         f = self.schema.field(field)
         with self._write_lock:
+            # cancel any in-flight build: orphaning its marker makes the
+            # publish-currency check refuse, so the dropped index cannot
+            # resurrect after this remove
+            self._field_builds.pop(field, None)
             if self._scalar_manager is not None:
                 self._scalar_manager.remove_field(field)
             f.scalar_index = ScalarIndexType.NONE
