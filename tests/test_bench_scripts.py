@@ -1,0 +1,31 @@
+"""Per-index benchmark suite smoke (reference intent:
+scripts/benchmarks/*.py are runnable against any build)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_per_index_bench_runs_and_reports():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "benchmarks",
+                                      "per_index.py"),
+         "--n", "8000", "--d", "16", "--indexes", "FLAT,IVFFLAT",
+         "--batches", "1,64"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(line) for line in out.stdout.splitlines()
+            if line.startswith("{")]
+    assert {(r["index"], r["batch"]) for r in rows} == {
+        ("FLAT", 1), ("FLAT", 64), ("IVFFLAT", 1), ("IVFFLAT", 64)}
+    for r in rows:
+        assert r["qps"] > 0 and r["p50_ms"] > 0
+        assert r["recall_at_10"] >= 0.8

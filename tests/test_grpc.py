@@ -133,6 +133,94 @@ def test_grpc_upsert_search_query_delete(stack):
     assert len(qr2.documents) == 0
 
 
+def test_grpc_delete_limit_zero_is_noop(stack):
+    """HTTP semantics survive proto3: limit=0 is a zero delete budget
+    (deletes nothing), absent limit is unbounded."""
+    router, cl, channel = stack
+    pb2 = load_pb2()
+    cl.upsert("g", "sp", [{"_id": f"z{i}", "color": "green",
+                           "emb": np.zeros(D, np.float32)}
+                          for i in range(5)])
+    delete = _stub(channel, pb2, "Delete", pb2.DeleteRequest,
+                   pb2.DeleteResponse)
+    filt = json.dumps({"operator": "AND", "conditions": [
+        {"operator": "=", "field": "color", "value": "green"}]})
+    out = delete(pb2.DeleteRequest(db_name="g", space_name="sp",
+                                   filters_json=filt, limit=0))
+    assert out.total == 0  # explicit zero budget: nothing deleted
+    out = delete(pb2.DeleteRequest(db_name="g", space_name="sp",
+                                   filters_json=filt))
+    assert out.total == 5  # absent: unbounded filtered delete
+
+
+def test_grpc_enforces_router_auth(tmp_path):
+    """An auth-enabled cluster must reject unauthenticated gRPC calls
+    (the gRPC port is a front door, not a side entrance) and honor the
+    same per-endpoint privileges as HTTP."""
+    import base64
+
+    from vearch_tpu.cluster import rpc as rpc_mod
+
+    master = MasterServer(auth=True, root_password="rootpw")
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=master.addr,
+                  master_auth=("root", "rootpw"))
+    ps.start()
+    router = RouterServer(master_addr=master.addr, auth=True,
+                          master_auth=("root", "rootpw"), grpc_port=0)
+    router.start()
+    try:
+        root = ("root", "rootpw")
+        rpc_mod.call(master.addr, "POST", "/dbs/adb", auth=root)
+        rpc_mod.call(master.addr, "POST", "/dbs/adb/spaces", {
+            "name": "s", "partition_num": 1,
+            "fields": [{"name": "emb", "data_type": "vector",
+                        "dimension": D,
+                        "index": {"index_type": "FLAT",
+                                  "metric_type": "L2", "params": {}}}],
+        }, auth=root)
+        rpc_mod.call(master.addr, "POST", "/users",
+                     {"name": "r1", "password": "pw", "role": "read"},
+                     auth=root)
+
+        pb2 = load_pb2()
+        channel = grpc.insecure_channel(router.grpc.addr)
+        upsert = _stub(channel, pb2, "Upsert", pb2.UpsertRequest,
+                       pb2.UpsertResponse)
+        req = pb2.UpsertRequest(db_name="adb", space_name="s",
+                                documents=[pb2.Document(
+                                    id="a", fields_json=json.dumps(
+                                        {"emb": [0.0] * D}))])
+        # no credentials -> UNAUTHENTICATED
+        with pytest.raises(grpc.RpcError) as e:
+            upsert(req)
+        assert e.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+        def md(user, pw):
+            tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+            return (("authorization", f"Basic {tok}"),)
+
+        # root upserts fine
+        out = upsert(req, metadata=md("root", "rootpw"))
+        assert out.total == 1
+        # read-only user: search ok, upsert PERMISSION_DENIED
+        search = _stub(channel, pb2, "Search", pb2.SearchRequest,
+                       pb2.SearchResponse)
+        resp = search(pb2.SearchRequest(
+            db_name="adb", space_name="s",
+            vectors=[pb2.VectorQuery(field="emb", feature=[0.0] * D)],
+            limit=1), metadata=md("r1", "pw"))
+        assert resp.results[0].items[0].id == "a"
+        with pytest.raises(grpc.RpcError) as e:
+            upsert(req, metadata=md("r1", "pw"))
+        assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        channel.close()
+    finally:
+        router.stop()
+        ps.stop()
+        master.stop()
+
+
 def test_grpc_error_status_mapping(stack):
     router, cl, channel = stack
     pb2 = load_pb2()

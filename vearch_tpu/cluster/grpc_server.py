@@ -114,9 +114,22 @@ class GrpcRouter:
         )
         pb2 = self.pb2
 
-        def handler(fn, req_cls, resp_cls):
+        def handler(fn, req_cls, resp_cls, http_path):
             def call(request, context):
                 try:
+                    # same BasicAuth + per-endpoint privilege enforcement
+                    # as the HTTP front door (an auth-enabled cluster
+                    # must not be writable through an unauthenticated
+                    # side entrance). Credentials ride the standard
+                    # `authorization` metadata key.
+                    authenticator = self.router.server.authenticator
+                    if authenticator is not None:
+                        md = {k: v for k, v in
+                              (context.invocation_metadata() or ())}
+                        headers = {
+                            "Authorization": md.get("authorization", "")
+                        }
+                        authenticator(headers, "POST", http_path)
                     return fn(request)
                 except RpcError as e:
                     context.abort(
@@ -134,13 +147,13 @@ class GrpcRouter:
             "vearch_tpu.Router",
             {
                 "Upsert": handler(self._upsert, pb2.UpsertRequest,
-                                  pb2.UpsertResponse),
+                                  pb2.UpsertResponse, "/document/upsert"),
                 "Search": handler(self._search, pb2.SearchRequest,
-                                  pb2.SearchResponse),
+                                  pb2.SearchResponse, "/document/search"),
                 "Query": handler(self._query, pb2.QueryRequest,
-                                 pb2.QueryResponse),
+                                 pb2.QueryResponse, "/document/query"),
                 "Delete": handler(self._delete, pb2.DeleteRequest,
-                                  pb2.DeleteResponse),
+                                  pb2.DeleteResponse, "/document/delete"),
             },
         )
         self.server.add_generic_rpc_handlers((service,))
@@ -172,17 +185,23 @@ class GrpcRouter:
             total=out["total"], document_ids=out["document_ids"])
 
     def _search(self, req):
+        import numpy as np
+
         body: dict[str, Any] = {
             "db_name": req.db_name,
             "space_name": req.space_name,
+            # np.asarray reads the packed repeated-scalar container
+            # directly — no intermediate PyFloat list on the hot path
             "vectors": [
-                {"field": v.field, "feature": list(v.feature),
-                 **({"min_score": v.min_score} if v.min_score else {}),
-                 **({"boost": v.boost} if v.boost else {})}
+                {"field": v.field,
+                 "feature": np.asarray(v.feature, dtype=np.float32),
+                 **({"min_score": v.min_score}
+                    if v.HasField("min_score") else {}),
+                 **({"boost": v.boost} if v.HasField("boost") else {})}
                 for v in req.vectors
             ],
         }
-        if req.limit:
+        if req.HasField("limit"):
             body["limit"] = req.limit
         if req.filters_json:
             body["filters"] = _loads(req.filters_json, "filters_json")
@@ -219,9 +238,9 @@ class GrpcRouter:
             body["document_ids"] = list(req.document_ids)
         if req.filters_json:
             body["filters"] = _loads(req.filters_json, "filters_json")
-        if req.limit:
+        if req.HasField("limit"):
             body["limit"] = req.limit
-        if req.offset:
+        if req.HasField("offset"):
             body["offset"] = req.offset
         if req.fields:
             body["fields"] = list(req.fields)
@@ -245,7 +264,9 @@ class GrpcRouter:
             body["document_ids"] = list(req.document_ids)
         if req.filters_json:
             body["filters"] = _loads(req.filters_json, "filters_json")
-        if req.limit:
+        if req.HasField("limit"):
+            # limit=0 stays a zero delete budget (deletes nothing),
+            # matching the documented HTTP semantics — absent = unbounded
             body["limit"] = req.limit
         out = self.router._h_delete(body, None)
         return self.pb2.DeleteResponse(total=out["total"])
