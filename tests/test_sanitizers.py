@@ -1,0 +1,129 @@
+"""ASAN/UBSAN build + run of the native modules (SURVEY row 54: the
+reference runs its C++ engine under sanitizer CI; here the csrc modules
+are rebuilt with -fsanitize=address,undefined and driven through build/
+search/save/load plus a concurrent-search phase in a subprocess — any
+heap error, OOB, UB, or use-after-free aborts the run and fails the
+test)."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCENARIO = r"""
+import importlib.util, os, sys, tempfile, threading
+import numpy as np
+
+def load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+hnsw = load(sys.argv[1], "vearch_hnsw")
+native = load(sys.argv[2], "vearch_native")
+
+# -- vearch_native: hashing, top-k merge, fvecs reader --------------------
+keys = [f"doc{i}" for i in range(5000)]
+h = np.frombuffer(native.murmur3_batch(keys), dtype=np.uint32)
+assert h.shape[0] == 5000
+
+rng = np.random.default_rng(0)
+scores = rng.standard_normal((8, 64)).astype(np.float32)
+ids = np.arange(8 * 64, dtype=np.int64).reshape(8, 64)
+s, i = native.merge_topk(scores.tobytes(), ids.tobytes(), 8, 64, 10, True)
+assert np.frombuffer(s, dtype=np.float32).shape[0] == 80
+
+with tempfile.NamedTemporaryFile(suffix=".fvecs", delete=False) as f:
+    arr = rng.standard_normal((100, 16)).astype(np.float32)
+    for row in arr:
+        f.write(np.int32(16).tobytes()); f.write(row.tobytes())
+    path = f.name
+raw, rn, rd = native.read_fvecs(path, -1)
+assert (rn, rd) == (100, 16)
+os.unlink(path)
+
+# -- vearch_hnsw: build / filtered search / save / load / concurrency ----
+dim, n = 24, 3000
+data = rng.standard_normal((n, dim)).astype(np.float32)
+g = hnsw.hnsw_new(dim, 12, 80, 0, 1234)
+first = hnsw.hnsw_add(g, data.tobytes(), n)
+assert hnsw.hnsw_count(g) == n
+
+q = data[:16]
+valid = np.ones(n, dtype=np.uint8); valid[::3] = 0
+sc, gi = hnsw.hnsw_search(g, q.tobytes(), 16, 10, 64, valid.tobytes())
+gi = np.frombuffer(gi, dtype=np.int64).reshape(16, 10)
+assert (gi[gi >= 0] % 3 != 0).all()  # filtered ids never surface
+
+with tempfile.TemporaryDirectory() as d:
+    p = os.path.join(d, "g.hnsw")
+    hnsw.hnsw_save(g, p)
+    g2 = hnsw.hnsw_load(dim, 12, 80, 0, p)
+    assert hnsw.hnsw_count(g2) == n
+    # concurrent searches on the loaded graph (C++ releases the GIL in
+    # search: ASAN would flag any unsynchronized memory error)
+    errs = []
+    def worker(seed):
+        try:
+            r = np.random.default_rng(seed)
+            for _ in range(20):
+                qq = data[r.integers(0, n, 8)]
+                hnsw.hnsw_search(g2, qq.tobytes(), 8, 5, 48, None)
+        except Exception as e:
+            errs.append(e)
+    ts = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs, errs
+    hnsw.hnsw_free(g2)
+hnsw.hnsw_free(g)
+print("SANITIZED RUN OK")
+"""
+
+
+@pytest.mark.slow
+def test_native_modules_under_asan_ubsan(tmp_path):
+    asan_rt = subprocess.run(
+        ["gcc", "-print-file-name=libasan.so"],
+        capture_output=True, text=True,
+    ).stdout.strip()
+    if not os.path.isabs(asan_rt):
+        pytest.skip("libasan runtime not available")
+    include = sysconfig.get_paths()["include"]
+    sos = {}
+    for name in ("vearch_hnsw", "vearch_native"):
+        so = str(tmp_path / f"{name}.asan.so")
+        r = subprocess.run(
+            ["g++", "-O1", "-g", "-shared", "-fPIC", "-std=c++17",
+             "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+             f"-I{include}", os.path.join(REPO, "csrc", f"{name}.cpp"),
+             "-o", so],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        sos[name] = so
+
+    scenario = tmp_path / "scenario.py"
+    scenario.write_text(SCENARIO)
+    out = subprocess.run(
+        [sys.executable, str(scenario), sos["vearch_hnsw"],
+         sos["vearch_native"]],
+        capture_output=True, text=True, timeout=300,
+        env={
+            **os.environ,
+            # python itself leaks by design; halt on real errors only
+            "LD_PRELOAD": asan_rt,
+            "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+            "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+        },
+    )
+    assert out.returncode == 0, (
+        f"sanitized run failed\nstdout:{out.stdout[-1500:]}\n"
+        f"stderr:{out.stderr[-3000:]}"
+    )
+    assert "SANITIZED RUN OK" in out.stdout
