@@ -137,10 +137,13 @@ type SpaceConfig struct {
 type Document map[string]any
 
 // SearchVector names one query vector batch for a field; Feature is a
-// flattened [b*d] batch.
+// flattened [b*d] batch. MinScore/MaxScore bound the field's
+// metric-oriented score (L2: squared distance, lower = closer).
 type SearchVector struct {
-	Field   string    `json:"field"`
-	Feature []float32 `json:"feature"`
+	Field    string    `json:"field"`
+	Feature  []float32 `json:"feature"`
+	MinScore *float64  `json:"min_score,omitempty"`
+	MaxScore *float64  `json:"max_score,omitempty"`
 }
 
 // SearchRequest mirrors POST /document/search.

@@ -57,10 +57,16 @@ pub struct SpaceConfig {
 }
 
 /// One query vector batch (`feature` is the flattened `[b*d]` batch).
+/// `min_score`/`max_score` bound the field's metric-oriented score
+/// (L2: squared distance, lower = closer).
 #[derive(Serialize, Deserialize, Debug, Clone)]
 pub struct SearchVector {
     pub field: String,
     pub feature: Vec<f32>,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub min_score: Option<f64>,
+    #[serde(skip_serializing_if = "Option::is_none")]
+    pub max_score: Option<f64>,
 }
 
 /// Client for a vearch-tpu router (documents) and its master proxy.

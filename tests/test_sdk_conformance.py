@@ -219,7 +219,8 @@ def _tree_keys(node, out: set):
 # check below stays strict
 _EXTRA_VALID = {
     "operator_type", "partition_name", "partition_rule", "type", "field",
-    "ranges", "value", "min_score", "boost", "ranker", "params", "weight",
+    "ranges", "value", "min_score", "max_score", "boost", "ranker",
+    "params", "weight",
     "load_balance", "request_id", "raft_consistent", "trace", "trace_id",
     "topn", "index_params", "anti_affinity", "enable_id_cache",
     "vector_value", "dbs", "spaces", "servers", "partitions", "alias",

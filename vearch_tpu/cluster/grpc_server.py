@@ -197,6 +197,8 @@ class GrpcRouter:
                  "feature": np.asarray(v.feature, dtype=np.float32),
                  **({"min_score": v.min_score}
                     if v.HasField("min_score") else {}),
+                 **({"max_score": v.max_score}
+                    if v.HasField("max_score") else {}),
                  **({"boost": v.boost} if v.HasField("boost") else {})}
                 for v in req.vectors
             ],

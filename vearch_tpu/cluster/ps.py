@@ -388,7 +388,16 @@ class PSServer:
         eng = self._engine(pid)
         t = op["type"]
         if t == "upsert":
-            return eng.upsert(op["documents"])
+            try:
+                return eng.upsert(op["documents"])
+            except ValueError as e:
+                # data-dependent rejection (e.g. a partial update whose
+                # base row vanished between propose and apply). Applies
+                # must NEVER raise: the entry is already committed, and
+                # an exception here would wedge the apply loop retrying
+                # it forever on every replica. Same state -> same error
+                # marker on every replica, so determinism holds.
+                return {"_rejected": str(e)}
         if t == "delete":
             return eng.delete(op["keys"])
         raise RpcError(500, f"unknown log op {t!r}")
@@ -634,8 +643,31 @@ class PSServer:
             doc if "_id" in doc else {**doc, "_id": uuid.uuid4().hex}
             for doc in body["documents"]
         ]
+        # partial updates (docs omitting vector fields) must reference an
+        # existing row — reject BEFORE proposing so a bad request never
+        # enters the replicated log (a rare post-propose race degrades to
+        # a deterministic _rejected apply marker instead)
+        eng = self._engine(pid)
+        vf = [f.name for f in eng.schema.vector_fields()]
+        batch_ids = set()
+        for doc in docs:
+            # None == omitted (a JSON null vector is the natural "keep
+            # the stored one" idiom); an _id provided earlier in this
+            # batch is a valid inheritance source too
+            missing = [n for n in vf if doc.get(n) is None]
+            if missing and str(doc["_id"]) not in batch_ids \
+                    and eng.table.docid_of(str(doc["_id"])) is None:
+                raise RpcError(
+                    400,
+                    f"document {doc['_id']!r} omits vector field(s) "
+                    f"{missing} and does not exist yet",
+                )
+            if not missing:
+                batch_ids.add(str(doc["_id"]))
         keys = self._node(pid).propose([{"type": "upsert",
                                          "documents": docs}])[0]
+        if isinstance(keys, dict) and "_rejected" in keys:
+            raise RpcError(400, keys["_rejected"])
         return {"keys": keys, "count": len(keys)}
 
     def _h_delete(self, body: dict, _parts) -> dict:
@@ -816,6 +848,9 @@ class PSServer:
             brute_force=bool(body.get("brute_force", False)),
             field_weights=body.get("field_weights") or {},
             index_params=body.get("index_params") or {},
+            score_bounds={
+                f: tuple(b) for f, b in body["score_bounds"].items()
+            } if body.get("score_bounds") else None,
             trace=trace,
             ctx=ctx,
         )

@@ -479,6 +479,12 @@ class RouterServer:
             for name, f in vf.items():
                 v = doc.get(name)
                 if v is None:
+                    if "_id" in doc:
+                        # partial update: the engine inherits the stored
+                        # vector of the doc this _id replaces (reference:
+                        # upsert with has_vector=False updates scalars
+                        # only) — and 400s if the _id is new
+                        continue
                     raise RpcError(400, f"missing vector field {name!r}")
                 if len(v) != f.wire_dim:
                     raise RpcError(
@@ -490,14 +496,18 @@ class RouterServer:
                 if k not in known:
                     raise RpcError(400, f"unknown field {k!r}")
 
-    def _parse_vectors(self, space: Space, body: dict) -> dict[str, Any]:
+    def _parse_vectors(
+        self, space: Space, body: dict
+    ) -> tuple[dict[str, Any], dict[str, tuple]]:
         """reference: doc_query.go:165 parseSearch — `vectors` is a list of
         {field, feature} with feature a flattened batch. Parsed into
         [b, d] float32 arrays so the router->PS hop rides the binary
-        tensor codec instead of JSON float lists."""
+        tensor codec instead of JSON float lists. Returns (vectors,
+        per-field (min_score, max_score) bounds)."""
         import numpy as np
 
         out: dict[str, Any] = {}
+        bounds: dict[str, tuple] = {}
         nq = None
         for v in body.get("vectors", []):
             f = space.schema.field(v["field"])
@@ -515,18 +525,23 @@ class RouterServer:
             elif nq != b:
                 raise RpcError(400, "inconsistent query batch across fields")
             out[v["field"]] = feat.reshape(b, wd)
+            if v.get("min_score") is not None or v.get("max_score") is not None:
+                # score window per vector query (reference: min_score/
+                # max_score in doc_query.go vector entries)
+                bounds[v["field"]] = (v.get("min_score"), v.get("max_score"))
         if not out:
             raise RpcError(400, "search requires `vectors`")
-        return out
+        return out, bounds
 
     def _h_search(self, body: dict, _parts) -> dict:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
-        vectors = self._parse_vectors(space, body)
+        vectors, score_bounds = self._parse_vectors(space, body)
         k = int(body.get("limit", body.get("topn", 10)))
         sub = {
             "vectors": vectors,
             "k": k,
+            "score_bounds": score_bounds or None,
             # forwarded so /ps/kill can target queries by the id the
             # client supplied (reference: Rqueue kill by request id)
             "request_id": body.get("request_id"),

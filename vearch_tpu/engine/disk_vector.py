@@ -109,6 +109,10 @@ class DiskRawVectorStore(RawVectorStore):
         self._n += b
         return start
 
+    def get(self, docid: int) -> np.ndarray:
+        """Single stored row as float32 (partial-update inheritance)."""
+        return self.get_rows(np.asarray([docid]))[0]
+
     def get_rows(self, docids: np.ndarray) -> np.ndarray:
         """Gather [len(docids), d] f32 rows (rerank path — pages fault in
         from disk on demand; hot rows ride the OS page cache)."""

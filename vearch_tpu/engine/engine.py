@@ -60,6 +60,12 @@ class SearchRequest:
     brute_force: bool = False  # force exact scan even when indexed
     field_weights: dict[str, float] = field(default_factory=dict)
     index_params: dict[str, Any] = field(default_factory=dict)  # nprobe etc.
+    # {field: (min_score, max_score)} — per-field windows on each
+    # field's OWN metric-oriented score, applied inside the rank merge
+    # for multi-field requests and on the final score for single-field
+    # ones (reference: min_score/max_score per vector query,
+    # test_document_search.py test_..._with_score_filter)
+    score_bounds: dict[str, tuple] | None = None
     # when not None, the engine records per-phase wall times into it
     # (reference: per-request trace:true timing breakdown,
     # client/client.go:521-565 + PerfTool, index_model.h:24)
@@ -165,30 +171,93 @@ class Engine:
 
         Mirrors reference engine.cc:691 AddOrUpdate: existing key ==
         update -> old docid soft-deleted, new row appended everywhere.
-        """
+        Partial updates carry omitted fields forward from the replaced
+        row — an upsert without the vector updates scalars only
+        (reference: test_document_upsert.py update() add(has_vector=
+        False)); a NEW document must bring every vector field."""
         vf = self.schema.vector_fields()
         keys: list[str] = []
         with self._write_lock:
             # batch the vector appends: one host copy per field per call;
-            # decode wire format (e.g. packed binary) via the index hook
+            # decode wire format (e.g. packed binary) via the index hook.
+            # A doc whose vector is absent OR null inherits the row it
+            # replaces — the latest provider of the same _id earlier in
+            # THIS batch, else the stored row. All resolution and
+            # validation happens BEFORE any mutation (a bad batch fails
+            # whole; a mid-batch failure would desync the docid==row-id
+            # invariant between table and vector stores forever), and
+            # deterministically from engine state so raft replicas
+            # resolve identically.
+            for doc in docs:
+                self.table.validate(
+                    {k: v for k, v in doc.items() if k != "_id"}
+                )
             mats = {}
             for f in vf:
                 idx = self.indexes[f.name]
-                raw = np.asarray([d[f.name] for d in docs]).reshape(
-                    len(docs), idx.input_dim
-                )
-                mats[f.name] = idx.decode_input(raw)
+                store = self.vector_stores[f.name]
+                have = [i for i, d in enumerate(docs)
+                        if d.get(f.name) is not None]
+                if len(have) == len(docs):
+                    raw = np.asarray([d[f.name] for d in docs]).reshape(
+                        len(docs), idx.input_dim
+                    )
+                    mats[f.name] = idx.decode_input(raw)
+                    continue
+                out = np.zeros((len(docs), store.dimension), np.float32)
+                if have:
+                    raw = np.asarray(
+                        [docs[i][f.name] for i in have]
+                    ).reshape(len(have), idx.input_dim)
+                    out[have] = idx.decode_input(raw)
+                latest: dict[str, int] = {}  # key -> out row in this batch
+                for i, d in enumerate(docs):
+                    key = str(d["_id"]) if "_id" in d else None
+                    if d.get(f.name) is not None:
+                        if key is not None:
+                            latest[key] = i
+                        continue
+                    src = latest.get(key) if key is not None else None
+                    if src is not None:
+                        out[i] = out[src]
+                        latest[key] = i
+                        continue
+                    old = (self.table.docid_of(key)
+                           if key is not None else None)
+                    if old is None:
+                        raise ValueError(
+                            f"document {key!r} omits vector field "
+                            f"{f.name!r} and has no existing row to "
+                            f"inherit it from"
+                        )
+                    out[i] = np.asarray(store.get(old), dtype=np.float32)
+                    latest[key] = i
+                mats[f.name] = out
+            merged_docs = []
             for i, doc in enumerate(docs):
                 key = str(doc["_id"]) if "_id" in doc else uuid.uuid4().hex
                 fields = {k: v for k, v in doc.items() if k != "_id"}
+                prev_id = self.table.docid_of(key)
+                if prev_id is not None:
+                    # partial scalar update: omitted fields keep their
+                    # previous values — but only fields the previous doc
+                    # actually SET (fixed columns materialize 0-defaults;
+                    # carrying those forward would index phantom values)
+                    prev_set = self.table.set_fields_of(prev_id)
+                    for name, val in self.table.get_fields(
+                            prev_id, list(prev_set)).items():
+                        fields.setdefault(name, val)
                 docid, old = self.table.add(key, fields)
                 if old is not None:
                     self.bitmap.set_deleted(old)
                 keys.append(key)
+                merged_docs.append(fields)
             for f in vf:
                 self.vector_stores[f.name].add(mats[f.name])
             if self._scalar_manager is not None:
-                self._scalar_manager.add_docs(docs, len(self.table._keys) - len(docs))
+                self._scalar_manager.add_docs(
+                    merged_docs, len(self.table._keys) - len(docs)
+                )
         self._maybe_start_build()
         return keys
 
@@ -455,6 +524,13 @@ class Engine:
                 except KeyError:
                     return self.table.string_column(field)[lo:hi]
 
+            def indexable(docid: int, value) -> bool:
+                # presence-gated like every other index-build path:
+                # fixed-column 0-defaults of never-set fields must not
+                # become filterable values
+                return (value is not None
+                        and field in self.table.set_fields_of(docid))
+
             built = 0
             # bulk phase, lock-free: columns are append-only so the
             # captured slice is stable
@@ -463,7 +539,7 @@ class Engine:
                 if hi <= built:
                     break
                 for docid, value in enumerate(rows(built, hi), start=built):
-                    if value is not None:
+                    if indexable(docid, value):
                         index.add(value, docid)
                 built = hi
             with self._write_lock:
@@ -475,7 +551,7 @@ class Engine:
                 # exact catch-up: rows that landed since the last pass
                 hi = self.table.doc_count
                 for docid, value in enumerate(rows(built, hi), start=built):
-                    if value is not None:
+                    if indexable(docid, value):
                         index.add(value, docid)
                 if self._scalar_manager is None:
                     from vearch_tpu.scalar.manager import ScalarIndexManager
@@ -726,13 +802,31 @@ class Engine:
                 out_scores.append([float("-inf")] * req.k)
                 continue
             total = np.zeros(len(cand), dtype=np.float64)
+            keep = np.ones(len(cand), dtype=bool)
             for name in names:
                 w = req.field_weights.get(name, 1.0)
-                total += w * self._exact_score(
+                sf = self._exact_score(
                     name, queries_by_field[name][qi], cand
                 )
+                if req.score_bounds and name in req.score_bounds:
+                    # per-field window on the FIELD's own score, as the
+                    # reference attaches min/max_score to each vector
+                    # query — not to the fused total
+                    from vearch_tpu.ops.distance import score_to_metric
+
+                    lo, hi = req.score_bounds[name]
+                    mf = np.asarray(score_to_metric(
+                        np.asarray(sf), self.indexes[name].metric))
+                    if lo is not None:
+                        keep &= mf >= lo
+                    if hi is not None:
+                        keep &= mf <= hi
+                total += w * sf
+            total = np.where(keep, total, -np.inf)
             order = np.argsort(-total)[: req.k]
-            ids_row = [cand[i] for i in order]
+            ids_row = [
+                cand[i] if np.isfinite(total[i]) else -1 for i in order
+            ]
             sc_row = [float(total[i]) for i in order]
             pad = req.k - len(ids_row)
             out_ids.append(ids_row + [-1] * pad)
@@ -757,6 +851,18 @@ class Engine:
         metric_scores = np.asarray(score_to_metric(scores, metric))
         want_fields = req.include_fields is None or bool(req.include_fields)
         ok = (ids >= 0) & np.isfinite(scores)
+        if req.score_bounds and len(req.vectors) == 1:
+            # single-field: the final score IS the field's score, so the
+            # window applies here; multi-field requests already applied
+            # per-field windows inside the rank merge
+            los = [b[0] for b in req.score_bounds.values()
+                   if b[0] is not None]
+            his = [b[1] for b in req.score_bounds.values()
+                   if b[1] is not None]
+            if los:
+                ok &= metric_scores >= max(los)
+            if his:
+                ok &= metric_scores <= min(his)
         flat_ids = ids[ok].astype(np.int64)
         keys = self.table.keys_for(flat_ids)
         fields_list = (
@@ -982,7 +1088,12 @@ class Engine:
                 meta = json.load(f)
             keys.extend(meta["keys"])
             for n in strings:
-                strings[n].extend(meta["strings"].get(n, []))
+                part = meta["strings"].get(n)
+                if part is None:
+                    # segment predates this column (e.g. the hidden
+                    # presence column): pad so lengths stay row-aligned
+                    part = [None] * len(meta["keys"])
+                strings[n].extend(part)
             data = np.load(os.path.join(sd, "table.npz"))
             for n in fixed_parts:
                 fixed_parts[n].append(data[n])

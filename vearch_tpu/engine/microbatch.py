@@ -61,6 +61,11 @@ def _compat_key(req: "SearchRequest") -> str:
         "weights": req.field_weights or {},
         "include": sorted(req.include_fields)
         if req.include_fields is not None else None,
+        # bounds are part of the key: the group request is built from
+        # the head, so mixing bounded and unbounded searches would
+        # silently drop (or wrongly apply) the score window
+        "bounds": {f: list(b) for f, b in sorted(req.score_bounds.items())}
+        if req.score_bounds else None,
     }, sort_keys=True, default=str)
 
 
@@ -185,6 +190,7 @@ class MicroBatcher:
                 brute_force=False,
                 field_weights=head.field_weights,
                 index_params=head.index_params,
+                score_bounds=head.score_bounds,
                 trace=trace,
             )
             results = self.engine._search_direct(big)

@@ -56,6 +56,14 @@ class _Column:
 
 
 class Table:
+    # hidden per-row presence column: which scalar fields the document
+    # actually provided (fixed columns materialize 0-defaults, so without
+    # this a partial update could not tell "price is 0" from "price was
+    # never set" and would carry phantom defaults forward). Lives inside
+    # _strings so every snapshot/dump/segment path persists it for free;
+    # rows from pre-presence dumps read back as None == "all set".
+    PRESENCE_COL = "__set__"
+
     def __init__(self, schema: TableSchema):
         self.schema = schema
         self._key_to_docid: dict[str, int] = {}
@@ -67,6 +75,8 @@ class Table:
                 self._fixed[f.name] = _Column(_FIXED_DTYPES[f.data_type])
             else:
                 self._strings[f.name] = []
+        self._strings[self.PRESENCE_COL] = []
+        self._presence_intern: dict[str, str] = {}
 
     @property
     def doc_count(self) -> int:
@@ -92,8 +102,56 @@ class Table:
         for name, col in self._fixed.items():
             col.append(fields.get(name))
         for name, lst in self._strings.items():
-            lst.append(fields.get(name))
+            if name == self.PRESENCE_COL:
+                provided = ",".join(sorted(
+                    k for k, v in fields.items()
+                    if v is not None
+                    and (k in self._fixed or (
+                        k in self._strings and k != self.PRESENCE_COL))
+                ))
+                lst.append(self._presence_intern.setdefault(
+                    provided, provided))
+            else:
+                lst.append(fields.get(name))
         return docid, old
+
+    def validate(self, fields: dict[str, Any]) -> None:
+        """Raise ValueError for values a typed column cannot take. Must
+        run BEFORE any mutation of a batch: _Column.append raising
+        mid-batch would leave table/vector-store row counts misaligned
+        forever (docid == row id is a core invariant)."""
+        for name, col in self._fixed.items():
+            v = fields.get(name)
+            if v is None:
+                continue
+            try:
+                np.asarray(v).astype(col.dtype)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"field {name!r} value {v!r} is not coercible to "
+                    f"{col.dtype}"
+                ) from None
+
+    def set_fields_of(self, docid: int) -> frozenset:
+        """Scalar fields the row's document actually provided. Rows
+        predating presence tracking (old dumps) report all fields.
+        Memoized per token — tokens are heavily shared across rows, so
+        per-row calls (e.g. index rebuild at load) stay O(1)."""
+        col = self._strings.get(self.PRESENCE_COL)
+        tok = col[docid] if col is not None and docid < len(col) else None
+        memo = getattr(self, "_presence_sets", None)
+        if memo is None:
+            memo = self._presence_sets = {}
+        got = memo.get(tok)
+        if got is None:
+            if tok is None:
+                got = frozenset(self._fixed) | frozenset(
+                    k for k in self._strings if k != self.PRESENCE_COL
+                )
+            else:
+                got = frozenset(tok.split(",")) if tok else frozenset()
+            memo[tok] = got
+        return got
 
     def delete(self, key: str) -> int | None:
         """Remove the key mapping; returns the docid to soft-delete."""
@@ -107,6 +165,8 @@ class Table:
             if names is None or name in names:
                 out[name] = col[docid].item()
         for name, lst in self._strings.items():
+            if name == self.PRESENCE_COL:
+                continue
             if names is None or name in names:
                 out[name] = lst[docid]
         return out
@@ -122,6 +182,8 @@ class Table:
             if names is None or name in names:
                 cols[name] = col._data[docids].tolist()
         for name, lst in self._strings.items():
+            if name == self.PRESENCE_COL:
+                continue
             if names is None or name in names:
                 cols[name] = [lst[i] for i in docids.tolist()]
         field_names = list(cols)
@@ -184,6 +246,9 @@ class Table:
         self._keys = meta["keys"]
         self._key_to_docid = {k: int(v) for k, v in meta["key_to_docid"].items()}
         self._strings = meta["strings"]
+        # pre-presence dumps: None rows read as "all fields set"
+        self._strings.setdefault(
+            self.PRESENCE_COL, [None] * len(self._keys))
         data = np.load(os.path.join(dirpath, "columns.npz"))
         for name, col in self._fixed.items():
             arr = data[name]
@@ -204,6 +269,7 @@ class Table:
         {key: docid | alive[docid]} (deleted keys' last rows are dead)."""
         self._keys = keys
         self._strings = strings
+        self._strings.setdefault(self.PRESENCE_COL, [None] * len(keys))
         for name, col in self._fixed.items():
             arr = fixed[name]
             col._data = arr.copy() if arr.base is not None else arr

@@ -81,7 +81,10 @@ class ScalarIndexManager:
     def add_docs(self, docs: list[dict[str, Any]], base_docid: int) -> None:
         for name, index in self._indexes.items():
             for i, doc in enumerate(docs):
-                if name in doc:
+                # None == unset (matches the engine's partial-update and
+                # presence conventions); a None in a numeric inverted
+                # index would TypeError later inside a filtered search
+                if doc.get(name) is not None:
                     index.add(doc[name], base_docid + i)
         for ci in self._composites:
             for i, doc in enumerate(docs):
@@ -106,7 +109,10 @@ class ScalarIndexManager:
 
         for name, index in self._indexes.items():
             for docid, value in enumerate(column_rows(name)):
-                if value is not None:
+                # presence-gated: fixed columns materialize 0-defaults
+                # for never-set fields; indexing those would make docs
+                # match filters on values they never had
+                if value is not None and name in table.set_fields_of(docid):
                     index.add(value, docid)
         for ci in self._composites:
             cols = {f: column_rows(f) for f in ci.fields}
