@@ -1,0 +1,193 @@
+"""Online space updates (reference: space_service.go:520 UpdateSpace;
+test_module_space.py test_update_space_partition + dynamic field
+management): partition_num expansion with slot re-carve, and new
+scalar-field addition on a live space."""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.rpc import RpcError
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("spup")), n_ps=2
+    ) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "sp", "partition_num": 1, "replica_num": 1,
+        "fields": [
+            {"name": "color", "data_type": "string"},
+            {"name": "emb", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    return cl
+
+
+@pytest.fixture(scope="module")
+def vecs(client):
+    rng = np.random.default_rng(9)
+    v = rng.standard_normal((60, D)).astype(np.float32)
+    client.upsert("db", "sp", [
+        {"_id": f"d{i}", "color": "red", "emb": v[i]} for i in range(60)
+    ])
+    return v
+
+
+def test_partition_expansion(client, cluster, vecs):
+    sp = client.get_space("db", "sp")
+    assert len(sp["partitions"]) == 1
+
+    out = client.update_space("db", "sp", {"partition_num": 2})
+    assert len(out["partitions"]) == 2
+    assert out["expanded"] is True
+    slots = [p["slot"] for p in out["partitions"]]
+    assert slots == [0, ((1 << 32) - 1) // 2]  # re-carved evenly
+
+    # shrink is rejected (reference: partition_num should be greater)
+    with pytest.raises(RpcError) as e:
+        client.update_space("db", "sp", {"partition_num": 1})
+    assert e.value.code == 400
+
+    # every pre-expansion doc is still readable by id (fan-out read:
+    # its slot may now belong to the new, empty partition)
+    docs = client.query("db", "sp",
+                        document_ids=[f"d{i}" for i in range(60)])
+    assert len(docs) == 60
+
+    # searches see old and new rows across both partitions
+    client.upsert("db", "sp", [
+        {"_id": f"n{i}", "color": "blue", "emb": vecs[i]}
+        for i in range(20)
+    ])
+    hits = client.search("db", "sp",
+                         [{"field": "emb", "feature": vecs[3].tolist()}],
+                         limit=2)
+    assert {h["_id"] for h in hits[0]} == {"d3", "n3"}
+
+    # delete by id reaches stale-slot copies too
+    assert client.delete("db", "sp", document_ids=["d3"]) == 1
+    docs = client.query("db", "sp", document_ids=["d3"])
+    assert docs == []
+
+    # updating a PRE-expansion doc must not create a second live copy:
+    # the upsert routes to the partition that holds it, not the slot
+    client.upsert("db", "sp", [{"_id": "d5", "color": "gold",
+                                "emb": vecs[5]}])
+    hits = client.search("db", "sp",
+                         [{"field": "emb", "feature": vecs[5].tolist()}],
+                         limit=3)
+    assert [h["_id"] for h in hits[0]].count("d5") == 1
+    docs = client.query("db", "sp", document_ids=["d5"])
+    assert docs[0]["color"] == "gold"  # the update took effect
+
+    # and a PARTIAL update of a pre-expansion doc still works (the slot
+    # owner does not know the _id; the holder does)
+    client.upsert("db", "sp", [{"_id": "d7", "color": "silver"}])
+    docs = client.query("db", "sp", document_ids=["d7"])
+    assert docs[0]["color"] == "silver"
+
+
+def test_add_scalar_field_on_live_space(client, cluster, vecs):
+    out = client.update_space("db", "sp", {"fields": [
+        {"name": "stock", "data_type": "integer",
+         "scalar_index": "INVERTED"},
+    ]})
+    assert out["fields_failed"] == []
+    names = [f["name"] for f in out["schema"]["fields"]]
+    assert "stock" in names
+
+    # new docs can set it; old docs filter as unset (NOT stock=0)
+    client.upsert("db", "sp", [
+        {"_id": "s1", "color": "green", "stock": 0,
+         "emb": np.zeros(D, dtype=np.float32)},
+        {"_id": "s2", "color": "green", "stock": 7,
+         "emb": np.ones(D, dtype=np.float32)},
+    ])
+    docs = client.query("db", "sp", filters={
+        "operator": "AND",
+        "conditions": [{"operator": "=", "field": "stock", "value": 0}]},
+        limit=200)
+    assert [d["_id"] for d in docs] == ["s1"]
+    docs = client.query("db", "sp", filters={
+        "operator": "AND",
+        "conditions": [{"operator": ">=", "field": "stock", "value": 1}]},
+        limit=200)
+    assert [d["_id"] for d in docs] == ["s2"]
+
+    # existing fields cannot be redefined
+    with pytest.raises(RpcError) as e:
+        client.update_space("db", "sp", {"fields": [
+            {"name": "color", "data_type": "integer"}]})
+    assert e.value.code == 400
+    # vector fields cannot be added live
+    with pytest.raises(RpcError) as e:
+        client.update_space("db", "sp", {"fields": [
+            {"name": "v2", "data_type": "vector", "dimension": 4}]})
+    assert e.value.code == 400
+
+
+def test_schema_reconcile_heals_missed_fanout(tmp_path):
+    """An engine that missed the /ps/schema/field fan-out converges via
+    the schema expectations riding heartbeat responses."""
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+
+    master = MasterServer()
+    master.start()
+    ps = PSServer(data_dir=str(tmp_path / "ps"),
+                  master_addr=master.addr, heartbeat_interval=0.3)
+    ps.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "sp", "partition_num": 1, "replica_num": 1,
+            "fields": [
+                {"name": "emb", "data_type": "vector", "dimension": D,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        cl.update_space("db", "sp", {"fields": [
+            {"name": "grade", "data_type": "float"}]})
+        eng = next(iter(ps.engines.values()))
+        assert any(f.name == "grade" for f in eng.schema.fields)
+
+        # simulate the miss: rip the field back out of the live engine
+        with eng._write_lock:
+            eng.schema.fields = [
+                f for f in eng.schema.fields if f.name != "grade"
+            ]
+            eng.table._fixed.pop("grade", None)
+
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if any(f.name == "grade" for f in eng.schema.fields):
+                break
+            time.sleep(0.1)
+        assert any(f.name == "grade" for f in eng.schema.fields), \
+            "heartbeat schema reconcile did not re-add the field"
+        assert "grade" in eng.table._fixed
+    finally:
+        router.stop()
+        ps.stop()
+        master.stop()

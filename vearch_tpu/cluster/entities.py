@@ -64,6 +64,12 @@ class Space:
     # replica placement anti-affinity: none|host|rack|zone (reference:
     # config.go:389 strategies 0-3)
     anti_affinity: str = "none"
+    # set once partition_num has been expanded online: slots were
+    # re-carved, so rows ingested before the expansion may live in a
+    # partition that no longer owns their slot — id-routed reads must
+    # fan out instead of slot-routing (reference: expandPartitions,
+    # space_service.go:792 — same re-carve, same consequence)
+    expanded: bool = False
     # id->docid cache toggle (reference: entity/space.go:88-94). Kept
     # for wire compat: this engine holds the key->docid map in-process
     # (table.py _key_to_docid — no FFI boundary to cache across), so the
@@ -86,6 +92,8 @@ class Space:
             d["anti_affinity"] = self.anti_affinity
         if not self.enable_id_cache:
             d["enable_id_cache"] = False
+        if self.expanded:
+            d["expanded"] = True
         return d
 
     @classmethod
@@ -101,6 +109,7 @@ class Space:
             partition_rule=d.get("partition_rule"),
             anti_affinity=d.get("anti_affinity", "none"),
             enable_id_cache=bool(d.get("enable_id_cache", True)),
+            expanded=bool(d.get("expanded", False)),
         )
 
     def slot_starts(self) -> list[int]:

@@ -132,6 +132,7 @@ class MasterServer:
         s.route("GET", "/watch", self._h_watch)
         s.route("POST", "/dbs", self._h_create_db)  # POST /dbs/{db}
         s.route("GET", "/dbs", self._h_get_db)
+        s.route("PUT", "/dbs", self._h_update_space)
         s.route("DELETE", "/dbs", self._h_delete_db)
         s.route("GET", "/partitions", self._h_partitions)
         s.route("POST", "/partitions/change_member", self._h_change_member)
@@ -800,14 +801,19 @@ class MasterServer:
             self.store.delete(f"/fail_server/{node_id}")
         if "partitions" in body:
             self._node_stats[node_id] = body["partitions"] or {}
-        # field-index expectations for the partitions this node hosts:
-        # heals replicas that missed a /field_index fan-out (transient
-        # RPC failure, or a restart that reloaded a stale local schema)
-        expect = self._field_index_expectations()
+        # field-index + schema expectations for the partitions this node
+        # hosts: heals replicas that missed a /field_index or
+        # /ps/schema/field fan-out (transient RPC failure, or a restart
+        # that reloaded a stale local schema)
+        expect, schemas = self._field_index_expectations()
         hosted = {str(pid) for pid in server.partition_ids}
         return {"node_id": node_id,
                 "field_indexes": {
                     pid: flags for pid, flags in expect.items()
+                    if pid in hosted
+                },
+                "schema_fields": {
+                    pid: flds for pid, flds in schemas.items()
                     if pid in hosted
                 }}
 
@@ -851,6 +857,119 @@ class MasterServer:
                 raise RpcError(404, f"space {db}/{parts[2]} not found")
             return sp
         raise RpcError(404, f"bad path {parts}")
+
+    def _h_update_space(self, body: dict, parts) -> dict:
+        """PUT /dbs/{db}/spaces/{space} — online space update (reference:
+        space_service.go:520 UpdateSpace): partition_num expansion and
+        new-scalar-field addition; immutable properties rejected."""
+        if len(parts) != 3 or parts[1] != "spaces":
+            raise RpcError(404, "PUT /dbs/{db}/spaces/{space}")
+        db, _, name = parts[0], parts[1], parts[2]
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        if not self.store.try_lock("space_create", f"{db}/{name}"):
+            raise RpcError(409, "space mutation in progress")
+        try:
+            sp = self.store.get(key)
+            if sp is None:
+                raise RpcError(404, f"space {db}/{name} not found")
+            space = Space.from_dict(sp)
+            if body.get("replica_num") and \
+                    int(body["replica_num"]) != space.replica_num:
+                raise RpcError(400, "replica_num can not change")
+            new_fields = []
+            if body.get("fields"):
+                new_fields = self._merge_new_fields(space, body["fields"])
+            pn = int(body.get("partition_num", 0))
+            if pn:
+                if space.partition_rule:
+                    raise RpcError(
+                        400, "rule spaces grow via /partitions/rule ADD")
+                if pn <= space.partition_num:
+                    raise RpcError(
+                        400,
+                        f"partition_num {pn} should be greater than "
+                        f"current {space.partition_num}",
+                    )
+                self._expand_partitions(space, pn)
+            self.store.put(key, space.to_dict())
+        finally:
+            self.store.unlock("space_create", f"{db}/{name}")
+        # fan the new fields out to live engines (a replica that misses
+        # this converges via the schema expectations riding heartbeats)
+        acked, failed = [], []
+        if new_fields:
+            servers = {s.node_id: s for s in self._alive_servers()}
+            for part in space.partitions:
+                for node_id in part.replicas:
+                    srv = servers.get(node_id)
+                    try:
+                        if srv is None:
+                            raise RpcError(503, "down")
+                        rpc.call(srv.rpc_addr, "POST", "/ps/schema/field",
+                                 {"partition_id": part.id,
+                                  "fields": new_fields})
+                        acked.append([part.id, node_id])
+                    except RpcError:
+                        failed.append([part.id, node_id])
+        out = space.to_dict()
+        if new_fields:
+            out["fields_acked"] = acked
+            out["fields_failed"] = failed
+        return out
+
+    def _merge_new_fields(self, space: Space, fields: list[dict]) -> list:
+        """Append-only schema evolution: brand-new scalar fields are
+        added; existing fields may not change (index changes go through
+        /field_index). Returns the new fields' dicts (reference:
+        updateSpaceFields, space_service.go:801 — only additions and
+        index-option changes allowed)."""
+        from vearch_tpu.engine.types import FieldSchema
+
+        existing = {f.name: f for f in space.schema.fields}
+        added = []
+        for d in fields:
+            f = FieldSchema.from_dict(d)
+            cur = existing.get(f.name)
+            if cur is not None:
+                if cur.to_dict() != f.to_dict():
+                    raise RpcError(
+                        400,
+                        f"field {f.name!r} exists; only new fields can "
+                        f"be added (index changes: POST /field_index)",
+                    )
+                continue
+            if f.data_type is DataType.VECTOR:
+                raise RpcError(
+                    400, "vector fields cannot be added to a live space")
+            space.schema.fields.append(f)
+            added.append(f.to_dict())
+        return added
+
+    def _expand_partitions(self, space: Space, pn: int) -> None:
+        """Grow a slot-sharded space to pn partitions: slots re-carve
+        evenly over the new count (existing partitions keep their id,
+        replicas, and data) and the new partitions are placed/created
+        (reference: expandPartitions, space_service.go:785-798)."""
+        servers = self._alive_servers()
+        if len(servers) < max(space.replica_num, 1):
+            raise RpcError(
+                503,
+                f"need {space.replica_num} alive servers, "
+                f"have {len(servers)}",
+            )
+        old = space.partition_num
+        space.partition_num = pn
+        slots = carve_slots(pn)
+        # the group creator rolls back on failure, so re-carve the
+        # existing partitions' slots only after the new ones exist —
+        # a failed expansion must leave the old routing intact
+        self._create_partition_group(space, servers, None,
+                                     slots=slots[old:])
+        for i, part in enumerate(space.partitions[:old]):
+            part.slot = slots[i]
+        # pre-expansion rows may now live off their slot's partition:
+        # id-routed reads must fan out from here on
+        space.expanded = True
 
     def _h_delete_db(self, _body, parts) -> dict:
         if len(parts) == 1:
@@ -1225,28 +1344,46 @@ class MasterServer:
             used_labels.add(pick.labels.get(label, f"~{pick.node_id}"))
         return chosen
 
-    def _create_partition_group(self, space: Space, servers, group) -> None:
-        """Create one group of partition_num slot-sharded partitions with
-        anti-affine least-loaded replica placement (reference:
-        space_service.go:141-149)."""
-        slots = carve_slots(space.partition_num)
-        for i in range(space.partition_num):
-            pid = self.store.next_id(SEQ_PARTITION_ID)
-            replicas = self._place_replicas(space, servers)
-            part = Partition(
-                id=pid, space_id=space.id, db_name=space.db_name,
-                space_name=space.name, slot=slots[i], replicas=replicas,
-                leader=replicas[0], group=group,
-            )
-            for node_id in replicas:
-                srv = next(s for s in servers if s.node_id == node_id)
-                rpc.call(srv.rpc_addr, "POST", "/ps/partition/create", {
-                    "partition": part.to_dict(),
-                    "schema": space.schema.to_dict(),
-                })
-                srv.partition_ids.append(pid)
-                self.store.put(f"{PREFIX_SERVER}{node_id}", srv.to_dict())
-            space.partitions.append(part)
+    def _create_partition_group(self, space: Space, servers, group,
+                                slots: list[int] | None = None) -> None:
+        """Create one group of slot-sharded partitions with anti-affine
+        least-loaded replica placement (reference:
+        space_service.go:141-149). `slots` defaults to a fresh carve of
+        partition_num; expansion passes just the new tail. A mid-way PS
+        failure rolls the whole group back — already-created engines are
+        dropped and server records restored — so a failed create/expand
+        leaves no orphan engines or phantom partition_ids behind."""
+        if slots is None:
+            slots = carve_slots(space.partition_num)
+        created: list[Partition] = []
+        by_id = {s.node_id: s for s in servers}
+        try:
+            for slot in slots:
+                pid = self.store.next_id(SEQ_PARTITION_ID)
+                replicas = self._place_replicas(space, servers)
+                part = Partition(
+                    id=pid, space_id=space.id, db_name=space.db_name,
+                    space_name=space.name, slot=slot, replicas=replicas,
+                    leader=replicas[0], group=group,
+                )
+                created.append(part)
+                for node_id in replicas:
+                    srv = by_id[node_id]
+                    rpc.call(srv.rpc_addr, "POST", "/ps/partition/create", {
+                        "partition": part.to_dict(),
+                        "schema": space.schema.to_dict(),
+                    })
+                    srv.partition_ids.append(pid)
+                    self.store.put(f"{PREFIX_SERVER}{node_id}",
+                                   srv.to_dict())
+                space.partitions.append(part)
+        except RpcError:
+            self._drop_partitions(created, servers)
+            space.partitions = [
+                p for p in space.partitions
+                if p.id not in {c.id for c in created}
+            ]
+            raise
 
     def _h_partition_rule(self, body: dict, _parts) -> dict:
         """Online add/drop of rule partitions (reference:
@@ -1380,17 +1517,22 @@ class MasterServer:
         return {"field": fname, "index_type": itype,
                 "acked": acked, "failed": failed}
 
-    def _field_index_expectations(self) -> dict[str, dict[str, str]]:
-        """{partition_id: {field: index_type}} over all spaces — the
-        master-side truth PS nodes reconcile against each heartbeat.
-        Cached on the watch revision (bumped by every store mutation) so
-        the per-2s-heartbeat cost is a dict lookup, not a space scan."""
+    def _field_index_expectations(
+        self,
+    ) -> tuple[dict[str, dict[str, str]], dict[str, list]]:
+        """({partition_id: {field: index_type}}, {partition_id: [scalar
+        field dicts]}) over all spaces — the master-side truth PS nodes
+        reconcile against each heartbeat (missed /field_index or
+        /ps/schema/field fan-outs converge here). Cached on the watch
+        revision (bumped by every store mutation) so the per-2s-heartbeat
+        cost is a dict lookup, not a space scan."""
         with self._watch_cond:
             rev = self._watch_rev
         cached = getattr(self, "_fidx_cache", None)
         if cached is not None and cached[0] == rev:
-            return cached[1]
+            return cached[1], cached[2]
         out: dict[str, dict[str, str]] = {}
+        schemas: dict[str, list] = {}
         for sp in self.store.prefix(PREFIX_SPACE).values():
             space = Space.from_dict(sp)
             flags = {
@@ -1399,10 +1541,15 @@ class MasterServer:
                 if f.data_type is not DataType.VECTOR
                 and f.scalar_index is not ScalarIndexType.NONE
             }
+            scalars = [
+                f.to_dict() for f in space.schema.fields
+                if f.data_type is not DataType.VECTOR
+            ]
             for part in space.partitions:
                 out[str(part.id)] = flags
-        self._fidx_cache = (rev, out)
-        return out
+                schemas[str(part.id)] = scalars
+        self._fidx_cache = (rev, out, schemas)
+        return out, schemas
 
     def _drop_partitions(self, parts: list[Partition], servers) -> None:
         """Delete partitions on their replicas and trim the ids from the

@@ -141,6 +141,7 @@ class PSServer:
         s.route("POST", "/ps/doc/query", self._h_query)
         s.route("POST", "/ps/index/build", self._h_build)
         s.route("POST", "/ps/field_index", self._h_field_index)
+        s.route("POST", "/ps/schema/field", self._h_schema_field)
         s.route("POST", "/ps/index/rebuild", self._h_rebuild)
         s.route("POST", "/ps/flush", self._h_flush)
         s.route("POST", "/ps/engine/config", self._h_engine_config)
@@ -278,11 +279,32 @@ class PSServer:
             except RpcError:
                 continue
             try:
+                self._reconcile_schema_fields(
+                    resp.get("schema_fields") or {}
+                )
                 self._reconcile_field_indexes(
                     resp.get("field_indexes") or {}
                 )
             except Exception:
                 _log.exception("field-index reconcile failed")
+
+    def _reconcile_schema_fields(
+        self, expect: dict[str, list]
+    ) -> None:
+        """Add scalar fields the master's schema has but this engine
+        lacks (missed /ps/schema/field fan-out or a restart from a
+        pre-addition local schema). Runs before the index reconcile so
+        a brand-new indexed field gets its column first."""
+        from vearch_tpu.engine.types import FieldSchema
+
+        for pid_s, flds in expect.items():
+            eng = self.engines.get(int(pid_s))
+            if eng is None:
+                continue
+            names = {f.name for f in eng.schema.fields}
+            for d in flds:
+                if d["name"] not in names:
+                    eng.add_schema_field(FieldSchema.from_dict(d))
 
     def _reconcile_field_indexes(
         self, expect: dict[str, dict[str, str]]
@@ -905,6 +927,22 @@ class PSServer:
                 background=bool(body.get("background", True)),
             )
         return {"field": body["field"], "index_type": itype}
+
+    def _h_schema_field(self, body: dict, _parts) -> dict:
+        """Master fan-out target for online scalar-field addition
+        (reference: updateSpaceFields -> engine schema update)."""
+        from vearch_tpu.engine.types import FieldSchema
+
+        eng = self._engine(body["partition_id"])
+        added = []
+        for d in body.get("fields", []):
+            f = FieldSchema.from_dict(d)
+            try:
+                eng.add_schema_field(f)
+            except ValueError as e:
+                raise RpcError(400, str(e)) from None
+            added.append(f.name)
+        return {"added": added}
 
     def _h_rebuild(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])

@@ -415,9 +415,44 @@ class RouterServer:
             return self._route_docs_by_rule(space, docs)
         pids = self._partition_of_keys(space, [str(d["_id"]) for d in docs])
         by_partition: dict[int, list[dict]] = {}
+        if space.expanded:
+            # after expansion a pre-expansion doc may live OFF its
+            # re-carved slot; slot-routing its update would create a
+            # second live copy (and 400 a partial update). Route each
+            # existing _id to the partition that actually HOLDS it; only
+            # genuinely new ids go to the slot owner.
+            holders = self._find_holders(
+                space, [str(d["_id"]) for d in docs])
+            for doc, pid in zip(docs, pids):
+                owner = holders.get(str(doc["_id"]), pid)
+                by_partition.setdefault(owner, []).append(doc)
+            return by_partition
         for doc, pid in zip(docs, pids):
             by_partition.setdefault(pid, []).append(doc)
         return by_partition
+
+    def _find_holders(
+        self, space: Space, keys: list[str]
+    ) -> dict[str, int]:
+        """{_id: partition_id} for ids that already exist somewhere in
+        the space (expanded-space upsert routing). One parallel
+        existence probe per partition."""
+        skey = (space.db_name, space.name)
+
+        def probe(pid: int):
+            out = self._call_partition(
+                skey, pid, "/ps/doc/query",
+                {"document_ids": keys, "fields": []})
+            return pid, [d["_id"] for d in out["documents"]]
+
+        holders: dict[str, int] = {}
+        futures = [self._pool.submit(probe, p.id)
+                   for p in space.partitions]
+        for f in futures:
+            pid, found = f.result()
+            for k in found:
+                holders.setdefault(k, pid)
+        return holders
 
     def _route_docs_by_rule(
         self, space: Space, docs: list[dict]
@@ -640,9 +675,21 @@ class RouterServer:
         space = self._space(*skey)
         if body.get("document_ids"):
             keys_in = [str(k) for k in body["document_ids"]]
-            # under a partition rule the owning partition depends on the
-            # rule field, not the key: fan the lookup to every partition
-            if space.partition_rule:
+            # routing choices (reference: test_module_space.py
+            # test_document_operation — partition_id targets one
+            # partition, get_by_hash forces slot routing):
+            # - explicit partition_id: only that partition
+            # - rule spaces: owner depends on the rule field -> fan out
+            # - expanded spaces: pre-expansion rows may live off their
+            #   re-carved slot -> fan out (unless get_by_hash)
+            if body.get("partition_id") is not None:
+                pid = int(body["partition_id"])
+                if pid not in {p.id for p in space.partitions}:
+                    raise RpcError(404, f"partition {pid} not in space")
+                by_partition = {pid: keys_in}
+            elif space.partition_rule or (
+                space.expanded and not body.get("get_by_hash")
+            ):
                 by_partition = {p.id: keys_in for p in space.partitions}
             else:
                 by_partition: dict[int, list[str]] = {}
@@ -705,7 +752,9 @@ class RouterServer:
         space = self._space(*skey)
         if body.get("document_ids"):
             keys_in = [str(k) for k in body["document_ids"]]
-            if space.partition_rule:
+            # expanded spaces: stale copies may live off-slot — a delete
+            # must reach every partition or resurrect via search results
+            if space.partition_rule or space.expanded:
                 by_partition = {p.id: keys_in for p in space.partitions}
             else:
                 by_partition: dict[int, list[str]] = {}

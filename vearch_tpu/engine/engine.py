@@ -587,6 +587,26 @@ class Engine:
         else:
             run()
 
+    def add_schema_field(self, f) -> None:
+        """Online schema evolution: add a NEW scalar field (reference:
+        updateSpaceFields — only additions allowed on live spaces).
+        Idempotent; vector fields are rejected."""
+        if f.data_type is DataType.VECTOR:
+            raise ValueError("vector fields cannot be added to a live space")
+        target = f.scalar_index
+        with self._write_lock:
+            if any(x.name == f.name for x in self.schema.fields):
+                return
+            # append with NO index flag: the flag flips only when the
+            # build publishes — the invariant the heartbeat reconcile
+            # relies on to retry a failed build (flag != master's
+            # expectation) instead of believing a dead index is live
+            f.scalar_index = ScalarIndexType.NONE
+            self.schema.fields.append(f)
+            self.table.add_field(f)
+        if target is not ScalarIndexType.NONE:
+            self.add_field_index(f.name, target.value)
+
     def remove_field_index(self, field: str) -> None:
         """Drop a field's scalar index; in-flight filtered searches fall
         back to the columnar scan (filter.py tolerates the race)."""

@@ -115,6 +115,21 @@ class Table:
                 lst.append(fields.get(name))
         return docid, old
 
+    def add_field(self, f) -> None:
+        """Append-only schema evolution: a new scalar column, backfilled
+        with defaults for existing rows. Presence tracking already marks
+        those rows as not having set it, so the defaults are inert for
+        filters and partial updates (reference: updateSpaceFields new-
+        field additions, space_service.go:826)."""
+        n = len(self._keys)
+        if f.data_type in _FIXED_DTYPES:
+            col = _Column(_FIXED_DTYPES[f.data_type])
+            for _ in range(n):
+                col.append(None)
+            self._fixed[f.name] = col
+        else:
+            self._strings[f.name] = [None] * n
+
     def validate(self, fields: dict[str, Any]) -> None:
         """Raise ValueError for values a typed column cannot take. Must
         run BEFORE any mutation of a batch: _Column.append raising

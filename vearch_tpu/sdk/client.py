@@ -147,6 +147,13 @@ class VearchClient:
         return rpc.call(self.addr, "POST", "/index/rebuild",
                         {"db_name": db_name, "space_name": space_name})
 
+    def update_space(self, db_name: str, space_name: str,
+                     config: dict) -> dict:
+        """Online space update (reference: UpdateSpace): expand
+        partition_num, or add new scalar fields via {"fields": [...]}."""
+        return rpc.call(self.addr, "PUT",
+                        f"/dbs/{db_name}/spaces/{space_name}", config)
+
     def add_field_index(
         self, db_name: str, space_name: str, field: str,
         index_type: str = "INVERTED", background: bool = True,
