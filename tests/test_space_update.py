@@ -142,6 +142,51 @@ def test_add_scalar_field_on_live_space(client, cluster, vecs):
     assert e.value.code == 400
 
 
+def test_space_mutation_lock_excludes_same_space(cluster):
+    """Two concurrent mutations of ONE space must not both acquire the
+    lock (reviewer-found lost-update race: the old scheme keyed the
+    lock name globally and the owner by space, so same-space mutations
+    re-granted). Different spaces stay concurrent."""
+    from vearch_tpu.cluster.rpc import RpcError as _RpcError
+
+    m = cluster.master
+    t1 = m._lock_space("db", "sp")
+    with pytest.raises(_RpcError) as e:
+        m._lock_space("db", "sp")
+    assert e.value.code == 409
+    t_other = m._lock_space("db", "other")  # different space: fine
+    m._unlock_space("db", "other", t_other)
+    m._unlock_space("db", "sp", t1)
+    t2 = m._lock_space("db", "sp")  # released: re-acquirable
+    m._unlock_space("db", "sp", t2)
+
+
+def test_expansion_echo_is_noop(client):
+    """Read-modify-write clients resubmit the whole space config;
+    partition_num == current must be accepted as a no-op."""
+    sp = client.get_space("db", "sp")
+    out = client.update_space("db", "sp",
+                              {"partition_num": sp["partition_num"]})
+    assert len(out["partitions"]) == len(sp["partitions"])
+
+
+def test_get_space_detail(client, cluster, vecs):
+    """?detail=true annotates partitions with heartbeat-borne doc/size
+    stats (reference: describe_space detail)."""
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        sp = client.get_space("db", "sp", detail=True)
+        total = sum(p.get("doc_count", 0) for p in sp["partitions"])
+        if total > 0:
+            break
+        time.sleep(0.5)
+    assert total > 0
+    assert all("size_bytes" in p for p in sp["partitions"])
+    # plain fetch stays unannotated
+    sp2 = client.get_space("db", "sp")
+    assert "doc_count" not in sp2["partitions"][0]
+
+
 def test_schema_reconcile_heals_missed_fanout(tmp_path):
     """An engine that missed the /ps/schema/field fan-out converges via
     the schema expectations riding heartbeat responses."""

@@ -855,8 +855,44 @@ class MasterServer:
             sp = self.store.get(f"{PREFIX_SPACE}{db}/{parts[2]}")
             if sp is None:
                 raise RpcError(404, f"space {db}/{parts[2]} not found")
+            detail = str(
+                ((_body or {}).get("_query") or {}).get("detail", "")
+            ).lower() in ("true", "1")
+            if detail:
+                # per-partition doc/size/status from heartbeat-borne
+                # stats (reference: describe_space ?detail=true returns
+                # partition doc/index counts)
+                sp = dict(sp)
+                parts_out = []
+                for p in sp.get("partitions", []):
+                    st = {}
+                    # list(): heartbeat threads mutate the dict under us
+                    for node_stats in list(self._node_stats.values()):
+                        got = node_stats.get(str(p["id"]))
+                        if got and (not st or got.get("leader")):
+                            st = got
+                    parts_out.append({**p,
+                                      "doc_count": st.get("doc_count", 0),
+                                      "size_bytes": st.get("size_bytes", 0),
+                                      "status": st.get("status")})
+                sp["partitions"] = parts_out
             return sp
         raise RpcError(404, f"bad path {parts}")
+
+    def _lock_space(self, db: str, name: str) -> str:
+        """Per-space mutation lock. The lock NAME is the space (so two
+        spaces mutate concurrently) and the owner a per-request token —
+        try_lock re-grants to the SAME owner, so using the space as the
+        owner (the old scheme) let two mutations of one space both
+        acquire (reviewer-found lost-update race). Raises 409 when the
+        space is already being mutated."""
+        token = uuid.uuid4().hex
+        if not self.store.try_lock(f"space_mutate/{db}/{name}", token):
+            raise RpcError(409, "space mutation in progress")
+        return token
+
+    def _unlock_space(self, db: str, name: str, token: str) -> None:
+        self.store.unlock(f"space_mutate/{db}/{name}", token)
 
     def _h_update_space(self, body: dict, parts) -> dict:
         """PUT /dbs/{db}/spaces/{space} — online space update (reference:
@@ -866,8 +902,7 @@ class MasterServer:
             raise RpcError(404, "PUT /dbs/{db}/spaces/{space}")
         db, _, name = parts[0], parts[1], parts[2]
         key = f"{PREFIX_SPACE}{db}/{name}"
-        if not self.store.try_lock("space_create", f"{db}/{name}"):
-            raise RpcError(409, "space mutation in progress")
+        token = self._lock_space(db, name)
         try:
             sp = self.store.get(key)
             if sp is None:
@@ -884,16 +919,20 @@ class MasterServer:
                 if space.partition_rule:
                     raise RpcError(
                         400, "rule spaces grow via /partitions/rule ADD")
-                if pn <= space.partition_num:
+                if pn < space.partition_num:
                     raise RpcError(
                         400,
                         f"partition_num {pn} should be greater than "
                         f"current {space.partition_num}",
                     )
-                self._expand_partitions(space, pn)
+                if pn > space.partition_num:
+                    # pn == current is a no-op, like echoing back an
+                    # unchanged replica_num: read-modify-write clients
+                    # resubmit the whole space config
+                    self._expand_partitions(space, pn)
             self.store.put(key, space.to_dict())
         finally:
-            self.store.unlock("space_create", f"{db}/{name}")
+            self._unlock_space(db, name, token)
         # fan the new fields out to live engines (a replica that misses
         # this converges via the schema expectations riding heartbeats)
         acked, failed = [], []
@@ -1245,8 +1284,7 @@ class MasterServer:
         key = f"{PREFIX_SPACE}{db}/{name}"
         if self.store.get(key) is not None:
             raise RpcError(409, f"space {db}/{name} exists")
-        if not self.store.try_lock("space_create", f"{db}/{name}"):
-            raise RpcError(409, "space create in progress")
+        token = self._lock_space(db, name)
         try:
             schema = TableSchema.from_dict(
                 {"name": name, **{k: body[k] for k in ("fields",) if k in body},
@@ -1288,7 +1326,7 @@ class MasterServer:
             self.store.put(key, space.to_dict())
             return space.to_dict()
         finally:
-            self.store.unlock("space_create", f"{db}/{name}")
+            self._unlock_space(db, name, token)
 
     def _validate_rule(self, rule: dict, schema: TableSchema) -> None:
         from vearch_tpu.cluster.entities import rule_value_ns
@@ -1395,12 +1433,11 @@ class MasterServer:
         key = f"{PREFIX_SPACE}{db}/{name}"
         # same lock as space create: concurrent ADD/DROP (or a racing
         # space delete) would read-modify-write over each other
-        if not self.store.try_lock("space_create", f"{db}/{name}"):
-            raise RpcError(409, "space mutation in progress")
+        token = self._lock_space(db, name)
         try:
             return self._partition_rule_locked(body, db, name, key)
         finally:
-            self.store.unlock("space_create", f"{db}/{name}")
+            self._unlock_space(db, name, token)
 
     def _partition_rule_locked(self, body, db, name, key) -> dict:
         from vearch_tpu.cluster.entities import rule_value_ns
@@ -1468,8 +1505,7 @@ class MasterServer:
         # lock covers ONLY the schema read-modify-write: the fan-out below
         # can outlive the lock TTL (sync builds, slow replicas) and does
         # not touch the space record
-        if not self.store.try_lock("space_create", f"{db}/{name}"):
-            raise RpcError(409, "space mutation in progress")
+        token = self._lock_space(db, name)
         try:
             sp = self.store.get(key)
             if sp is None:
@@ -1488,7 +1524,7 @@ class MasterServer:
                 raise RpcError(400, f"unknown index_type {itype!r}") from None
             self.store.put(key, space.to_dict())
         finally:
-            self.store.unlock("space_create", f"{db}/{name}")
+            self._unlock_space(db, name, token)
 
         # best-effort fan-out: a replica that misses it (dead, or a
         # transient RPC failure) converges anyway — field-index

@@ -366,6 +366,14 @@ class RouterServer:
     def _proxy_master(self, method: str, prefix: str):
         def h(body, parts):
             path = prefix + ("/" + "/".join(parts) if parts else "")
+            if isinstance(body, dict) and body.get("_query"):
+                # re-encode query params stripped by routing so e.g.
+                # GET space?detail=true survives the proxy hop
+                from urllib.parse import urlencode
+
+                q = body.pop("_query")
+                path += "?" + urlencode(q)
+                body = body or None
             return self._master_call(method, path, body)
 
         return h
@@ -449,7 +457,14 @@ class RouterServer:
         futures = [self._pool.submit(probe, p.id)
                    for p in space.partitions]
         for f in futures:
-            pid, found = f.result()
+            try:
+                pid, found = f.result()
+            except RpcError:
+                # one unreachable partition must not take down writes
+                # bound for healthy ones: ids it may hold fall back to
+                # slot routing (worst case a duplicate copy that the
+                # next holder-routed update or fan-out delete retires)
+                continue
             for k in found:
                 holders.setdefault(k, pid)
         return holders

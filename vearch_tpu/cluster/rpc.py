@@ -319,6 +319,16 @@ class JsonRpcServer:
                     body = _decode(
                         self.headers.get("Content-Type") or JSON_CT, raw
                     )
+                    if "?" in self.path:
+                        # URL query params ride into dict bodies under
+                        # "_query" (reference: ?detail=true etc.);
+                        # handlers opt in by reading it
+                        from urllib.parse import parse_qs, urlparse
+
+                        q = {k: v[-1] for k, v in parse_qs(
+                            urlparse(self.path).query).items()}
+                        if q and (body is None or isinstance(body, dict)):
+                            body = {**(body or {}), "_query": q}
                     if outer.middleware is not None:
                         short = outer.middleware(
                             method, self.path.split("?")[0], body,

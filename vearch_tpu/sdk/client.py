@@ -37,7 +37,16 @@ class VearchClient:
     def drop_space(self, db_name: str, space_name: str) -> dict:
         return rpc.call(self.addr, "DELETE", f"/dbs/{db_name}/spaces/{space_name}")
 
-    def get_space(self, db_name: str, space_name: str) -> dict:
+    def get_space(self, db_name: str, space_name: str,
+                  detail: bool = False) -> dict:
+        if detail:
+            # per-partition doc/size/status (reference: ?detail=true)
+            return rpc.call(
+                self.addr, "GET",
+                f"/dbs/{db_name}/spaces/{space_name}?detail=true")
+        return self._get_space_plain(db_name, space_name)
+
+    def _get_space_plain(self, db_name: str, space_name: str) -> dict:
         return rpc.call(self.addr, "GET", f"/dbs/{db_name}/spaces/{space_name}")
 
     def list_spaces(self, db_name: str) -> list[dict]:
