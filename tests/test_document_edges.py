@@ -209,6 +209,34 @@ def test_partial_update_does_not_index_phantom_defaults(tmp_path):
     assert [d["_id"] for d in docs] == ["b"], docs
 
 
+def test_partial_update_on_disk_store(tmp_path):
+    """Vector inheritance reads the stored row off the mmap disk tier
+    (DiskRawVectorStore.get) — partial updates must work for
+    store_type: RocksDB/Disk spaces too."""
+    from vearch_tpu.engine.disk_vector import DiskRawVectorStore
+    from vearch_tpu.engine.engine import Engine, SearchRequest
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+
+    schema = TableSchema("t", [
+        FieldSchema("tag", DataType.STRING),
+        FieldSchema("v", DataType.VECTOR, dimension=16,
+                    index=IndexParams("FLAT", MetricType.L2,
+                                      {"store_type": "RocksDB"})),
+    ])
+    eng = Engine(schema, data_dir=str(tmp_path / "d"))
+    assert isinstance(eng.vector_stores["v"], DiskRawVectorStore)
+    rng = np.random.default_rng(21)
+    vecs = rng.standard_normal((30, 16)).astype(np.float32)
+    eng.upsert([{"_id": f"k{i}", "tag": "a", "v": vecs[i]}
+                for i in range(30)])
+    eng.upsert([{"_id": "k4", "tag": "b"}])  # scalars only
+    res = eng.search(SearchRequest(vectors={"v": vecs[4:5]}, k=1))
+    assert res[0].items[0].key == "k4"
+    assert res[0].items[0].fields["tag"] == "b"
+
+
 def test_microbatch_score_bounds_not_shared():
     """Concurrent bounded and unbounded searches must not co-batch into
     one request that drops the window (reviewer-found silent-wrong-

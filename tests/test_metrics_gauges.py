@@ -142,3 +142,26 @@ def test_fail_server_gauge_moves_on_ps_death(cluster):
             break
         time.sleep(0.5)
     assert value == 1.0, value
+
+
+def test_process_system_gauges(cluster):
+    """Every role exports node/process stats (reference:
+    pkg/metrics/mserver system metrics in the monitor registry)."""
+    import sys
+    import urllib.request
+
+    if sys.platform != "linux":
+        pytest.skip("/proc-derived stats are Linux-only by design")
+
+    for addr in (cluster.master_addr, cluster.router_addr,
+                 cluster.ps_nodes[0].addr):
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        stats = {
+            line.split('stat="')[1].split('"')[0]
+            for line in text.splitlines()
+            if line.startswith("vearch_process{")
+        }
+        assert {"rss_bytes", "cpu_seconds", "threads",
+                "open_fds"} <= stats, (addr, stats)

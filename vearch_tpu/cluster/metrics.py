@@ -161,3 +161,40 @@ class Registry:
     def render(self) -> str:
         with self._lock:
             return "\n".join(m.render() for m in self._metrics) + "\n"
+
+
+def register_process_gauges(registry: "Registry") -> None:
+    """Node/process system gauges on every role (reference:
+    pkg/metrics/mserver system stats feeding the monitor registry):
+    RSS, virtual size, CPU seconds, open fds, threads, uptime — read
+    from /proc (zero-dep; silently absent off Linux)."""
+    import os
+    import time as _time
+
+    start = _time.monotonic()  # clock steps must not bend uptime
+    tick = float(os.sysconf("SC_CLK_TCK")) if hasattr(os, "sysconf") else 100.0
+    page = float(os.sysconf("SC_PAGE_SIZE")) if hasattr(os, "sysconf") else 4096.0
+
+    def read() -> dict[tuple, float]:
+        out: dict[tuple, float] = {}
+        try:
+            with open("/proc/self/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            # fields after comm: utime=11 stime=12 num_threads=17
+            # vsize=20 rss=21 (0-based in this post-comm slice)
+            out[("cpu_seconds",)] = (float(parts[11]) + float(parts[12])) / tick
+            out[("threads",)] = float(parts[17])
+            out[("vsize_bytes",)] = float(parts[20])
+            out[("rss_bytes",)] = float(parts[21]) * page
+        except (OSError, IndexError, ValueError):
+            pass
+        try:
+            out[("open_fds",)] = float(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        out[("uptime_seconds",)] = _time.monotonic() - start
+        return out
+
+    registry.callback_gauge(
+        "vearch_process", "process/system stats", ("stat",), read,
+    )

@@ -29,7 +29,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from vearch_tpu.cluster.metrics import Registry
+from vearch_tpu.cluster.metrics import Registry, register_process_gauges
 from vearch_tpu.utils import log
 
 _log = log.get("rpc")
@@ -175,6 +175,7 @@ class JsonRpcServer:
         # multi-master follower->leader proxy hangs here)
         self.middleware: Callable | None = None
         self.metrics = Registry()
+        register_process_gauges(self.metrics)
         self._m_requests = self.metrics.counter(
             "vearch_request_total", "RPC requests",
             ("method", "path", "code"),
