@@ -51,6 +51,21 @@ def make_masters(tmp_path, n=3, timeout=1.0, _attempt=0, **kw):
     return masters
 
 
+def call_retry(addr, method, path, body=None, timeout=25.0, **kw):
+    """rpc.call that rides out election windows: on a loaded CI box the
+    leader can flap between wait_leader() and the API call, turning a
+    deterministic test into a 503 flake. Retries leaderless/unreachable
+    errors until the group converges again."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return rpc.call(addr, method, path, body, **kw)
+        except rpc.RpcError as e:
+            if e.code not in (-1, 503) or time.time() > deadline:
+                raise
+            time.sleep(0.3)
+
+
 def wait_leader(masters, timeout=30.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -73,7 +88,7 @@ def test_election_and_replicated_writes(tmp_path):
         leader = wait_leader(masters)
         followers = [m for m in masters if m is not leader]
         # write through a FOLLOWER: it must proxy to the leader
-        rpc.call(followers[0].addr, "POST", "/dbs/repl")
+        call_retry(followers[0].addr, "POST", "/dbs/repl")
         # the write is visible on every master's local store
         for m in masters:
             out = rpc.call(m.addr, "GET", "/dbs")
@@ -93,7 +108,7 @@ def test_leader_death_metadata_survives(tmp_path):
     masters = make_masters(tmp_path)
     try:
         leader = wait_leader(masters)
-        rpc.call(multi_addr(masters), "POST", "/dbs/durable")
+        call_retry(multi_addr(masters), "POST", "/dbs/durable")
         leader.stop()
         alive = [m for m in masters if m is not leader]
         new_leader = wait_leader(alive)
@@ -101,7 +116,7 @@ def test_leader_death_metadata_survives(tmp_path):
         # metadata survives and writes keep working through any address
         out = rpc.call(multi_addr(alive), "GET", "/dbs")
         assert [d["name"] for d in out["dbs"]] == ["durable"]
-        rpc.call(multi_addr(alive), "POST", "/dbs/after")
+        call_retry(multi_addr(alive), "POST", "/dbs/after")
         out = rpc.call(multi_addr(alive), "GET", "/dbs")
         assert {d["name"] for d in out["dbs"]} == {"durable", "after"}
     finally:
@@ -172,14 +187,14 @@ def test_restarted_master_catches_up(tmp_path):
     masters = make_masters(tmp_path)
     try:
         wait_leader(masters)
-        rpc.call(multi_addr(masters), "POST", "/dbs/before")
+        call_retry(multi_addr(masters), "POST", "/dbs/before")
         # stop a follower, write more, restart it on the same dirs
         leader = next(m for m in masters if m.is_leader)
         victim = next(m for m in masters if not m.is_leader)
         vid = victim.node_id
         victim.stop()
-        rpc.call(multi_addr([m for m in masters if m is not victim]),
-                 "POST", "/dbs/while_down")
+        call_retry(multi_addr([m for m in masters if m is not victim]),
+                   "POST", "/dbs/while_down")
         # the victim's dirs live under whichever attempt dir its group
         # bootstrapped in — recover them from its own store path
         vdir = victim.store._persist_path.rsplit("/", 1)[0]
@@ -239,7 +254,7 @@ def test_multimaster_with_auth(tmp_path):
         with pytest.raises(rpc.RpcError, match="Basic auth"):
             rpc.call(follower.addr, "POST", "/dbs/nope")
         # authenticated write through a follower: proxied + replicated
-        rpc.call(follower.addr, "POST", "/dbs/authed", auth=root)
+        call_retry(follower.addr, "POST", "/dbs/authed", auth=root)
         for m in masters:
             out = rpc.call(m.addr, "GET", "/dbs", auth=root)
             assert [d["name"] for d in out["dbs"]] == ["authed"]
@@ -256,7 +271,7 @@ def test_far_behind_master_catches_up_via_snapshot(tmp_path):
     masters = make_masters(tmp_path, meta_log_keep=8, meta_flush_every=10)
     try:
         wait_leader(masters)
-        rpc.call(multi_addr(masters), "POST", "/dbs/base")
+        call_retry(multi_addr(masters), "POST", "/dbs/base")
         victim = next(m for m in masters if not m.is_leader)
         vid = victim.node_id
         victim.stop()
@@ -264,7 +279,7 @@ def test_far_behind_master_catches_up_via_snapshot(tmp_path):
         # push the log far past keep=8 while the victim is down so its
         # resume point is compacted away on the leader
         for i in range(60):
-            rpc.call(multi_addr(alive), "POST", f"/dbs/fill{i}")
+            call_retry(multi_addr(alive), "POST", f"/dbs/fill{i}")
         # wait for the checkpoint loop to truncate behind the horizon
         deadline = time.time() + 20
         while time.time() < deadline:
