@@ -12,6 +12,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
+def test_headline_bench_dryrun_pipeline():
+    """VEARCH_BENCH_DRYRUN runs bench.py's FULL pipeline at toy scale on
+    CPU — a bench-code regression must fail HERE, not in the one
+    hardware run that counts (r2/r3 lost their rounds to a dead tunnel;
+    a bench bug would waste the round it comes back)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "VEARCH_BENCH_DRYRUN": "1"}, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["value"] > 0 and "error" not in line
+    assert line["unit"] == "qps" and line["vs_baseline"] > 0
+
+
+@pytest.mark.slow
 def test_per_index_bench_runs_and_reports():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "benchmarks",

@@ -57,12 +57,18 @@ def call_retry(addr, method, path, body=None, timeout=25.0, **kw):
     deterministic test into a 503 flake. Retries leaderless/unreachable
     errors until the group converges again."""
     deadline = time.time() + timeout
+    attempt = 0
     while True:
         try:
             return rpc.call(addr, method, path, body, **kw)
         except rpc.RpcError as e:
+            if e.code == 409 and attempt and "exists" in e.msg:
+                # a previous attempt committed but the response was lost
+                # mid-flap: the write demonstrably landed
+                return None
             if e.code not in (-1, 503) or time.time() > deadline:
                 raise
+            attempt += 1
             time.sleep(0.3)
 
 
