@@ -1,0 +1,222 @@
+"""Master admin/ops routes added for reference parity (reference:
+cluster_api.go:257-354 — router registry, cluster stats/health, members,
+fail-server list/clear, manual recover, clean_lock, user/role/alias
+updates)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.rpc import RpcError
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    with StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("admin")), n_ps=2
+    ) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "sp", "partition_num": 2, "replica_num": 1,
+            "fields": [
+                {"name": "emb", "data_type": "vector", "dimension": D,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        rng = np.random.default_rng(0)
+        cl.upsert("db", "sp", [
+            {"_id": f"d{i}",
+             "emb": rng.standard_normal(D).astype(np.float32)}
+            for i in range(30)
+        ])
+        yield c
+
+
+def test_router_registry(cluster):
+    deadline = time.time() + 25
+    routers = []
+    while time.time() < deadline:
+        routers = rpc.call(cluster.master_addr, "GET",
+                           "/routers")["routers"]
+        if routers:
+            break
+        time.sleep(0.5)
+    assert any(r["addr"] == cluster.router_addr for r in routers)
+
+
+def test_cluster_stats_and_health(cluster):
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        stats = rpc.call(cluster.master_addr, "GET",
+                         "/cluster/stats")["stats"]
+        total = sum(
+            p.get("doc_count", 0)
+            for node in stats for p in node["partitions"].values()
+        )
+        if total >= 30:
+            break
+        time.sleep(0.5)
+    assert total >= 30
+    assert {node["node_id"] for node in stats} == {
+        ps.node_id for ps in cluster.ps_nodes}
+
+    health = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+    assert health["status"] == "green"
+    sp = next(s for s in health["spaces"] if s["name"] == "sp")
+    assert all(p["status"] == "green" for p in sp["partitions"])
+
+
+def test_members_view(cluster):
+    out = rpc.call(cluster.master_addr, "GET", "/members")["members"]
+    assert len(out) == 1 and out[0]["leader"] is True
+
+
+def test_fail_server_list_and_clear(cluster):
+    m = cluster.master
+    m.store.put("/fail_server/999", {"node_id": 999, "time": time.time()})
+    fails = rpc.call(cluster.master_addr, "GET",
+                     "/schedule/fail_server")["fail_servers"]
+    assert any(f["node_id"] == 999 for f in fails)
+    rpc.call(cluster.master_addr, "DELETE", "/schedule/fail_server/999")
+    fails = rpc.call(cluster.master_addr, "GET",
+                     "/schedule/fail_server")["fail_servers"]
+    assert not any(f["node_id"] == 999 for f in fails)
+    with pytest.raises(RpcError):
+        rpc.call(cluster.master_addr, "DELETE",
+                 "/schedule/fail_server/999")
+
+
+def test_clean_lock(cluster):
+    m = cluster.master
+    # a crashed mutation leaves an expired lock behind
+    m.store.try_lock("space_mutate/db/crashed", "tok", ttl_s=0.0)
+    # a live lock must survive the sweep
+    live = m._lock_space("db", "held")
+    out = rpc.call(cluster.master_addr, "GET", "/clean_lock")
+    assert "space_mutate/db/crashed" in out["cleaned"]
+    assert "space_mutate/db/held" in out["held"]
+    m._unlock_space("db", "held", live)
+
+
+def test_user_and_role_update(cluster):
+    rpc.call(cluster.master_addr, "POST", "/roles",
+             {"name": "custom", "privileges": {"Document": "Read"}})
+    rpc.call(cluster.master_addr, "POST", "/users",
+             {"name": "u1", "password": "a", "role": "read"})
+    out = rpc.call(cluster.master_addr, "PUT", "/users",
+                   {"name": "u1", "password": "b", "role": "custom"})
+    assert out["role"] == "custom"
+    # new password verifies, old does not
+    ok = rpc.call(cluster.master_addr, "POST", "/auth/check",
+                  {"name": "u1", "password": "b"})
+    assert ok["role"] == "custom"
+    with pytest.raises(RpcError):
+        rpc.call(cluster.master_addr, "POST", "/auth/check",
+                 {"name": "u1", "password": "a"})
+    out = rpc.call(cluster.master_addr, "PUT", "/roles",
+                   {"name": "custom",
+                    "privileges": {"Document": "WriteRead"}})
+    assert out["privileges"]["Document"] == "WriteRead"
+    with pytest.raises(RpcError):  # built-ins immutable
+        rpc.call(cluster.master_addr, "PUT", "/roles",
+                 {"name": "read", "privileges": {}})
+    with pytest.raises(RpcError):  # root role fixed
+        rpc.call(cluster.master_addr, "PUT", "/users",
+                 {"name": "root", "role": "custom"})
+
+
+def test_alias_put_modifies(cluster):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_space("db", {
+        "name": "sp2", "partition_num": 1,
+        "fields": [{"name": "emb", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    rpc.call(cluster.router_addr, "POST", "/alias/al/dbs/db/spaces/sp")
+    out = rpc.call(cluster.router_addr, "GET", "/alias/al")
+    assert out["space_name"] == "sp"
+    rpc.call(cluster.router_addr, "PUT", "/alias/al/dbs/db/spaces/sp2")
+    out = rpc.call(cluster.router_addr, "GET", "/alias/al")
+    assert out["space_name"] == "sp2"
+
+
+def test_manual_recover_server(tmp_path):
+    """POST /schedule/recover_server re-places a dead node's replicas
+    immediately instead of waiting out recover_delay."""
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+
+    # recover_delay is effectively infinite: only the manual kick works
+    master = MasterServer(heartbeat_ttl=2.0, recover_delay=3600.0)
+    master.start()
+    ps1 = PSServer(data_dir=str(tmp_path / "ps1"),
+                   master_addr=master.addr, heartbeat_interval=0.5)
+    ps1.start()
+    ps2 = PSServer(data_dir=str(tmp_path / "ps2"),
+                   master_addr=master.addr, heartbeat_interval=0.5)
+    ps2.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    ps3 = None
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = np.random.default_rng(1).standard_normal(
+            (20, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(20)])
+        # a third node to re-place onto, then kill ps2
+        ps3 = PSServer(data_dir=str(tmp_path / "ps3"),
+                       master_addr=master.addr, heartbeat_interval=0.5)
+        ps3.start()
+        dead_id = ps2.node_id
+        ps2.stop(flush=False)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            fails = rpc.call(master.addr, "GET",
+                             "/schedule/fail_server")["fail_servers"]
+            if any(f["node_id"] == dead_id for f in fails):
+                break
+            time.sleep(0.3)
+        assert any(f["node_id"] == dead_id for f in fails), \
+            "fail record never appeared"
+
+        rpc.call(master.addr, "POST", "/schedule/recover_server",
+                 {"node_id": dead_id})
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sp = cl.get_space("db", "s")
+            replicas = sp["partitions"][0]["replicas"]
+            if dead_id not in replicas and len(replicas) == 2:
+                break
+            time.sleep(0.5)
+        assert dead_id not in sp["partitions"][0]["replicas"]
+        hits = cl.search("db", "s",
+                         [{"field": "v", "feature": vecs[3].tolist()}],
+                         limit=1)
+        assert hits[0][0]["_id"] == "d3"
+    finally:
+        router.stop()
+        for node in (ps1, ps3):
+            if node is not None:
+                try:
+                    node.stop(flush=False)
+                except Exception:
+                    pass
+        master.stop()

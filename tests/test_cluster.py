@@ -336,3 +336,17 @@ def test_search_rides_binary_codec(client, docs_and_vecs):
     hits = client.search("db1", "space1",
                          [{"field": "emb", "feature": vecs[12]}], limit=1)
     assert hits[0][0]["_id"] == "doc12"
+
+
+def test_router_cache_space_view(client, cluster):
+    """GET /cache/dbs/{db}/spaces/{space} serves the ROUTER's cached
+    space (reference: doc_http.go:330 cacheSpaceInfo)."""
+    from vearch_tpu.cluster import rpc as rpc_mod
+
+    out = rpc_mod.call(cluster.router_addr, "GET",
+                       "/cache/dbs/db1/spaces/space1")
+    assert out["name"] == "space1"
+    assert len(out["partitions"]) == 3
+    with pytest.raises(rpc_mod.RpcError):
+        rpc_mod.call(cluster.router_addr, "GET",
+                     "/cache/dbs/db1/spaces/nope")

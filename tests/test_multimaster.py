@@ -331,3 +331,36 @@ def test_far_behind_master_catches_up_via_snapshot(tmp_path):
                 m.stop()
             except Exception:
                 pass
+
+
+def test_follower_ops_views_forward_to_leader(tmp_path, rng):
+    """GET /cluster/stats and /members on a FOLLOWER must reflect the
+    leader's heartbeat-fed state, not the follower's empty in-memory
+    view (reviewer-found: heartbeats land on the leader only)."""
+    masters = make_masters(tmp_path)
+    ps = None
+    try:
+        leader = wait_leader(masters)
+        maddr = multi_addr(masters)
+        ps = PSServer(data_dir=str(tmp_path / "ps"), master_addr=maddr,
+                      heartbeat_interval=0.3)
+        ps.start()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if rpc.call(leader.addr, "GET", "/servers")["servers"]:
+                break
+            time.sleep(0.3)
+        follower = next(m for m in masters if not m.is_leader)
+        stats = call_retry(follower.addr, "GET", "/cluster/stats")["stats"]
+        assert [s["node_id"] for s in stats] == [ps.node_id]
+        members = rpc.call(follower.addr, "GET", "/members")["members"]
+        leaders = [m["node_id"] for m in members if m["leader"]]
+        assert leaders == [leader.node_id]
+    finally:
+        if ps is not None:
+            ps.stop(flush=False)
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass

@@ -72,9 +72,11 @@ def parse_resources(endpoint: str, method: str) -> tuple[str, str]:
     reads that ride POST."""
     privilege = PRIVI_READ if method == "GET" else PRIVI_WRITE
     e = endpoint
-    if e.startswith("/cluster") or e == "/":
+    if (e.startswith("/cluster") or e == "/" or e.startswith("/members")
+            or e.startswith("/clean_lock")):
         return RESOURCE_CLUSTER, privilege
-    if e.startswith("/servers") or e.startswith("/register"):
+    if (e.startswith("/servers") or e.startswith("/register")
+            or e.startswith("/routers") or e.startswith("/schedule")):
         return RESOURCE_SERVER, privilege
     if e.startswith("/partitions"):
         return RESOURCE_PARTITION, privilege
@@ -123,6 +125,18 @@ def has_permission(role_name: str, privileges: dict[str, str],
                 403,
                 f"role {role_name!r} ResourceAll grant {grant} does not "
                 f"extend to {resource} (admin surface)",
+            )
+        # cluster-topology mutations (recover/fail-server/member ops) are
+        # likewise admin surface: a blanket WriteOnly data grant must not
+        # let a data writer force replica re-placement or erase failure
+        # records (reference: ops routes are ClusterAdmin-gated)
+        if needed != PRIVI_READ and resource in (
+            RESOURCE_SERVER, RESOURCE_CLUSTER, RESOURCE_PARTITION
+        ) and grant != PRIVI_ALL:
+            raise RpcError(
+                403,
+                f"role {role_name!r} ResourceAll grant {grant} does not "
+                f"extend to {resource} mutations (admin surface)",
             )
     if grant == needed or grant == PRIVI_ALL:
         return
@@ -191,6 +205,35 @@ class AuthService:
                 "role": role}
         self.store.put(f"/user/{name}", user)
         return {"name": name, "role": role}
+
+    def update_user(self, name: str, password: str | None = None,
+                    role: str | None = None) -> dict:
+        """Change a user's password and/or role (reference: updateUser).
+        Root's role is fixed; its password may rotate."""
+        u = self.store.get(f"/user/{name}")
+        if u is None:
+            raise RpcError(404, f"user {name} not found")
+        if role is not None:
+            if name == ROOT_NAME:
+                raise RpcError(400, "cannot change root's role")
+            if self.store.get(f"/role/{role}") is None:
+                raise RpcError(404, f"role {role} not found")
+            u["role"] = role
+        if password is not None:
+            u["password"] = hash_password(password)
+        self.store.put(f"/user/{name}", u)
+        return {"name": name, "role": u["role"]}
+
+    def update_role(self, name: str, privileges: dict[str, str]) -> dict:
+        """Replace a role's privilege map (reference:
+        changeRolePrivilege). Built-in roles are immutable."""
+        if name in BUILTIN_ROLES:
+            raise RpcError(400, f"built-in role {name!r} is immutable")
+        if self.store.get(f"/role/{name}") is None:
+            raise RpcError(404, f"role {name} not found")
+        role = {"name": name, "privileges": privileges}
+        self.store.put(f"/role/{name}", role)
+        return role
 
     def delete_user(self, name: str) -> None:
         if name == ROOT_NAME:

@@ -117,7 +117,8 @@ class MasterServer:
             # raft transport stay open (peer RPCs carry no credentials;
             # reference: /register is in the unauthenticated group and
             # etcd peer traffic is not BasicAuth'd)
-            auth_exempt=("/register", "/auth/check", "/", "/master/raft"),
+            auth_exempt=("/register", "/register_router", "/auth/check",
+                         "/", "/master/raft"),
         )
         s = self.server
         s.route("POST", "/auth/check", self._h_auth_check)
@@ -128,7 +129,19 @@ class MasterServer:
         s.route("GET", "/roles", self._h_get_role)
         s.route("GET", "/", self._h_cluster_info)
         s.route("POST", "/register", self._h_register)
+        s.route("POST", "/register_router", self._h_register_router)
         s.route("GET", "/servers", self._h_servers)
+        s.route("GET", "/routers", self._h_routers)
+        s.route("GET", "/cluster/stats", self._h_cluster_stats)
+        s.route("GET", "/cluster/health", self._h_cluster_health)
+        s.route("GET", "/members", self._h_members)
+        s.route("GET", "/schedule/fail_server", self._h_fail_servers)
+        s.route("DELETE", "/schedule/fail_server",
+                self._h_fail_server_clear)
+        s.route("POST", "/schedule/recover_server", self._h_recover_server)
+        s.route("GET", "/clean_lock", self._h_clean_lock)
+        s.route("PUT", "/users", self._h_update_user)
+        s.route("PUT", "/roles", self._h_update_role)
         s.route("GET", "/watch", self._h_watch)
         s.route("POST", "/dbs", self._h_create_db)  # POST /dbs/{db}
         s.route("GET", "/dbs", self._h_get_db)
@@ -142,6 +155,8 @@ class MasterServer:
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)
         s.route("POST", "/alias", self._h_create_alias)
+        # PUT modifies (reference: modifyAlias) — same upsert semantics
+        s.route("PUT", "/alias", self._h_create_alias)
         s.route("GET", "/alias", self._h_get_alias)
         s.route("DELETE", "/alias", self._h_delete_alias)
 
@@ -161,6 +176,12 @@ class MasterServer:
         self._watch_cond = threading.Condition()
 
         def _on_meta_change(event: str, key: str, _value) -> None:
+            if key.startswith("/router/"):
+                # ops-only registry: no client caches hang off it, and
+                # waking every watcher for each router lease re-grant
+                # would reintroduce the churn the guarded heartbeat put
+                # avoids
+                return
             with self._watch_cond:
                 self._watch_rev += 1
                 self._watch_ring.append((self._watch_rev, key))
@@ -368,6 +389,20 @@ class MasterServer:
                 self.store.revoke_lease(old)
             lease = self.store.grant_lease(self.heartbeat_ttl)
             self._leases[nid] = lease
+            self.store.put(key, val, lease=lease)
+        # router registry entries age out the same way: without a fresh
+        # lease on the NEW leader, a router that died across the
+        # promotion would be listed forever
+        leases = getattr(self, "_router_leases", None)
+        if leases is None:
+            leases = self._router_leases = {}
+        for key, val in self.store.prefix("/router/").items():
+            addr = key[len("/router/"):]
+            old = leases.get(addr)
+            if old is not None:
+                self.store.revoke_lease(old)
+            lease = self.store.grant_lease(60.0)
+            leases[addr] = lease
             self.store.put(key, val, lease=lease)
 
     def _election_loop(self) -> None:
@@ -765,6 +800,161 @@ class MasterServer:
             return r
         return {"roles": list(self.store.prefix("/role/").values())}
 
+    def _h_update_user(self, body: dict, _parts) -> dict:
+        """PUT /users — change a user's password and/or role
+        (reference: cluster_api.go updateUser)."""
+        return self.auth_service.update_user(
+            body["name"], password=body.get("password"),
+            role=body.get("role"),
+        )
+
+    def _h_update_role(self, body: dict, _parts) -> dict:
+        """PUT /roles — replace a role's privilege map (reference:
+        cluster_api.go changeRolePrivilege)."""
+        return self.auth_service.update_role(
+            body["name"], body.get("privileges", {})
+        )
+
+    # -- router registry (reference: register_router + GET /routers —
+    #    lease-backed like PS registration, so ops can see the router
+    #    fleet and dead routers age out) --------------------------------------
+
+    def _h_register_router(self, body: dict, _parts) -> dict:
+        addr = str(body["addr"])
+        key = f"/router/{addr}"
+        leases = getattr(self, "_router_leases", None)
+        if leases is None:
+            leases = self._router_leases = {}
+        lease = leases.get(addr)
+        ttl = 60.0  # routers re-register per watch-poll (<=20s cadence)
+        if lease is None or not self.store.keepalive(lease, ttl):
+            lease = self.store.grant_lease(ttl)
+            leases[addr] = lease
+            self.store.put(key, {"addr": addr, "register_time": time.time()},
+                           lease=lease)
+        return {"addr": addr}
+
+    def _h_routers(self, _body, _parts) -> dict:
+        return {"routers": list(self.store.prefix("/router/").values())}
+
+    # -- cluster ops views (reference: /cluster/stats, /cluster/health,
+    #    /members, /schedule/*, /clean_lock) ---------------------------------
+
+    def _leader_get(self, path: str):
+        """Forward a GET to the current meta leader when heartbeat-fed
+        in-memory state is needed (heartbeats land on the leader only;
+        followers would serve empty views). Returns None when THIS node
+        leads (caller serves locally)."""
+        if not self.replicated or self.is_leader:
+            return None
+        hint = self.meta_node.leader_hint
+        if hint is None or hint == self.node_id or hint not in self.peers:
+            raise RpcError(503, "no metadata leader known yet")
+        return rpc.call(self.peers[hint], "GET", path)
+
+    def _h_cluster_stats(self, _body, _parts) -> dict:
+        """Per-node partition stats as last heartbeated (reference:
+        cluster_api.go stats)."""
+        fwd = self._leader_get("/cluster/stats")
+        if fwd is not None:
+            return fwd
+        servers = {s.node_id: s for s in self._alive_servers()}
+        return {"stats": [
+            {"node_id": nid, "rpc_addr": srv.rpc_addr,
+             "partitions": dict(self._node_stats.get(nid, {}))}
+            for nid, srv in sorted(servers.items())
+        ]}
+
+    def _h_cluster_health(self, _body, _parts) -> dict:
+        """Per-space health roll-up (reference: cluster_api.go health):
+        green = every partition leader-alive and fully replicated,
+        yellow = serving but under-replicated, red = leaderless."""
+        servers = {s.node_id for s in self._alive_servers()}
+        spaces = []
+        worst = "green"
+        rank = {"green": 0, "yellow": 1, "red": 2}
+        for sp in self.store.prefix(PREFIX_SPACE).values():
+            status = "green"
+            parts = []
+            for p in sp.get("partitions", []):
+                alive = [r for r in p["replicas"] if r in servers]
+                if p["leader"] not in servers:
+                    pstat = "red"
+                elif len(alive) < int(sp.get("replica_num", 1)):
+                    pstat = "yellow"
+                else:
+                    pstat = "green"
+                parts.append({"id": p["id"], "status": pstat,
+                              "alive_replicas": len(alive)})
+                if rank[pstat] > rank[status]:
+                    status = pstat
+            spaces.append({"db_name": sp["db_name"], "name": sp["name"],
+                           "status": status, "partitions": parts})
+            if rank[status] > rank[worst]:
+                worst = status
+        return {"status": worst if spaces else "green", "spaces": spaces}
+
+    def _h_members(self, _body, _parts) -> dict:
+        """Metadata-raft membership (reference: GET /members). Static in
+        this design — members come from --peers; add/remove would need a
+        joint-consensus step the reference gets from etcd (declined in
+        docs/PARITY.md)."""
+        if self.replicated:
+            leader_id = (self.node_id if self.is_leader
+                         else self.meta_node.leader_hint)
+        else:
+            leader_id = self.node_id
+        return {"members": [
+            {"node_id": nid, "addr": addr, "leader": nid == leader_id}
+            for nid, addr in sorted(self.peers.items())
+        ]}
+
+    def _h_fail_servers(self, _body, _parts) -> dict:
+        return {"fail_servers": [
+            {"node_id": int(k.rsplit("/", 1)[1]), **v}
+            for k, v in sorted(self.store.prefix("/fail_server/").items())
+        ]}
+
+    def _h_fail_server_clear(self, _body, parts) -> dict:
+        if not parts:
+            raise RpcError(404, "DELETE /schedule/fail_server/{node_id}")
+        node_id = int(parts[0])
+        if not self.store.delete(f"/fail_server/{node_id}"):
+            raise RpcError(404, f"no fail record for node {node_id}")
+        return {"node_id": node_id}
+
+    def _h_recover_server(self, body: dict, _parts) -> dict:
+        """Kick replica re-placement NOW for a failed node instead of
+        waiting out recover_delay (reference: RecoverFailServer)."""
+        node_id = int(body["node_id"])
+        key = f"/fail_server/{node_id}"
+        rec = self.store.get(key)
+        if rec is None:
+            raise RpcError(404, f"no fail record for node {node_id}")
+        # age the record past the delay gate, then run one recover pass.
+        # NOTE: the pass's may_replace gate still holds re-placement
+        # while ANY OTHER failure is younger than recover_delay — report
+        # that honestly instead of claiming recovery started
+        self.store.put(key, {**rec, "time": 0.0})
+        others_fresh = any(
+            int(k.rsplit("/", 1)[1]) != node_id
+            and time.time() - v["time"] < self.recover_delay
+            for k, v in self.store.prefix("/fail_server/").items()
+        )
+        with self._reconfig_lock:
+            self._auto_recover_once()
+        return {"node_id": node_id,
+                "recover_started": not others_fresh,
+                **({"blocked_by_fresh_failures": True}
+                   if others_fresh else {})}
+
+    def _h_clean_lock(self, _body, _parts) -> dict:
+        """List + clear expired space-mutation locks (reference:
+        GET /clean_lock — ops escape hatch for locks orphaned by a
+        crashed mutation; live locks are left alone)."""
+        cleaned, held = self.store.clean_expired_locks()
+        return {"cleaned": cleaned, "held": held}
+
     # -- servers -------------------------------------------------------------
 
     def _h_register(self, body: dict, _parts) -> dict:
@@ -861,7 +1051,12 @@ class MasterServer:
             if detail:
                 # per-partition doc/size/status from heartbeat-borne
                 # stats (reference: describe_space ?detail=true returns
-                # partition doc/index counts)
+                # partition doc/index counts). Heartbeats land on the
+                # leader; followers forward rather than serve zeros.
+                fwd = self._leader_get(
+                    f"/dbs/{db}/spaces/{parts[2]}?detail=true")
+                if fwd is not None:
+                    return fwd
                 sp = dict(sp)
                 parts_out = []
                 for p in sp.get("partitions", []):

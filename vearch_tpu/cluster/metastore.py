@@ -196,6 +196,18 @@ class MetaStore:
             if cur is not None and cur["owner"] == owner:
                 self._locks.pop(name, None)
 
+    def clean_expired_locks(self) -> tuple[list[str], list[str]]:
+        """(cleaned, still-held) lock names. Runs under the store lock so
+        the sweep cannot race a concurrent try_lock re-acquiring a name
+        it just judged expired."""
+        with self._lock:
+            now = time.time()
+            cleaned = [n for n, c in self._locks.items()
+                       if c["expiry"] <= now]
+            for n in cleaned:
+                self._locks.pop(n, None)
+            return cleaned, sorted(self._locks)
+
     # -- snapshots (replicated mode: checkpoint + log truncation) ------------
 
     def snapshot_bytes(self) -> bytes:

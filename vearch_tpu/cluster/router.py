@@ -86,11 +86,12 @@ class RouterServer:
         # master proxy (reference: doc_http.go:189-251 master-proxy routes)
         for method in ("GET", "POST", "PUT", "DELETE"):
             s.route(method, "/dbs", self._proxy_master(method, "/dbs"))
-        for method in ("GET", "POST", "DELETE"):
+        for method in ("GET", "POST", "PUT", "DELETE"):
             s.route(method, "/alias", self._proxy_master(method, "/alias"))
         s.route("GET", "/servers", self._proxy_master("GET", "/servers"))
         s.route("POST", "/partitions/rule", self._h_partition_rule)
         s.route("POST", "/field_index", self._h_field_index)
+        s.route("GET", "/cache/dbs", self._h_cache_space)
         s.route("GET", "/cluster/health", self._h_health)
         s.route("GET", "/router/stats", self._h_router_stats)
         s.tracer = self.tracer  # serves GET /debug/traces
@@ -122,6 +123,14 @@ class RouterServer:
 
     def _watch_loop(self) -> None:
         while not self._watch_stop.is_set():
+            try:
+                # lease-backed registry entry (reference: register_router
+                # + GET /routers); the <=20s poll cadence keeps the 60s
+                # lease alive, dead routers age out
+                self._master_call("POST", "/register_router",
+                                  {"addr": self.addr})
+            except RpcError:
+                pass
             try:
                 out = self._master_call("GET", "/watch", {
                     "rev": self._watch_rev, "timeout": 20.0,
@@ -377,6 +386,14 @@ class RouterServer:
             return self._master_call(method, path, body)
 
         return h
+
+    def _h_cache_space(self, _body, parts) -> dict:
+        """GET /cache/dbs/{db}/spaces/{space} — THIS router's cached
+        view of the space (reference: doc_http.go:330 cacheSpaceInfo;
+        ops use it to check router cache freshness vs the master)."""
+        if len(parts) != 3 or parts[1] != "spaces":
+            raise RpcError(404, "GET /cache/dbs/{db}/spaces/{space}")
+        return self._space(parts[0], parts[2]).to_dict()
 
     def _h_health(self, _body, _parts) -> dict:
         return self._master_call("GET", "/")
