@@ -1,0 +1,66 @@
+"""pyvearch-shaped object SDK (reference: core/vearch.py Vearch /
+core/space.py Space call shapes) against a live cluster."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.objects import Vearch
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def vc(tmp_path_factory):
+    with StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("objsdk")), n_ps=1
+    ) as c:
+        yield Vearch(c.router_addr)
+
+
+def test_object_model_end_to_end(vc):
+    assert vc.is_live()
+    db = vc.create_database("shop")
+    assert vc.is_database_exist("shop")
+    assert db.exist()
+
+    space = db.space("items").create({
+        "partition_num": 1, "replica_num": 1,
+        "fields": [
+            {"name": "price", "data_type": "float"},
+            {"name": "emb", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    ok, schema = space.exist()
+    assert ok and schema["name"] == "items"
+    assert [s.name for s in db.list_spaces()] == ["items"]
+
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((30, D)).astype(np.float32)
+    ids = space.upsert([
+        {"_id": f"d{i}", "price": float(i), "emb": vecs[i]}
+        for i in range(30)
+    ])
+    assert len(ids) == 30
+
+    hits = space.search([{"field": "emb", "feature": vecs[4].tolist()}],
+                        limit=2)
+    assert hits[0][0]["_id"] == "d4"
+
+    docs = space.query(filters={"operator": "AND", "conditions": [
+        {"operator": ">=", "field": "price", "value": 28.0}]}, limit=10)
+    assert {d["_id"] for d in docs} == {"d28", "d29"}
+
+    space.create_index("price", "INVERTED")
+    assert space.delete(document_ids=["d0"]) == 1
+    assert space.query(document_ids=["d0"]) == []
+
+    info = space.describe(detail=False)
+    assert info["partition_num"] == 1
+
+    space.drop()
+    assert space.exist() == (False, None)
+    vc.drop_database("shop")
+    assert not vc.is_database_exist("shop")
