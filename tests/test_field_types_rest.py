@@ -1,0 +1,121 @@
+"""Field-type and filter breadth over the REST surface (reference:
+test_module_filter.py operator combos; test_module_vector.py string
+arrays; date-typed fields). Engine-level coverage exists in
+test_scalar_filter.py — this exercises the same semantics end-to-end
+through router JSON."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def client(tmp_path_factory):
+    with StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("ftypes")), n_ps=2
+    ) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "sp", "partition_num": 2, "replica_num": 1,
+            "fields": [
+                {"name": "tags", "data_type": "stringArray"},
+                {"name": "kind", "data_type": "string",
+                 "scalar_index": "BITMAP"},
+                {"name": "born", "data_type": "date"},
+                {"name": "count", "data_type": "long"},
+                {"name": "ok", "data_type": "bool"},
+                {"name": "emb", "data_type": "vector", "dimension": D,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        rng = np.random.default_rng(3)
+        vecs = rng.standard_normal((60, D)).astype(np.float32)
+        day = 86_400_000
+        cl.upsert("db", "sp", [
+            {"_id": f"d{i}",
+             "tags": [f"t{i % 5}", f"g{i % 3}"],
+             "kind": ["a", "b", "c"][i % 3],
+             "born": i * day,
+             "count": int(i) * 10,
+             "ok": i % 2 == 0,
+             "emb": vecs[i]}
+            for i in range(60)
+        ])
+        yield cl
+
+
+def _q(cl, conditions, operator="AND", limit=200):
+    return {d["_id"] for d in cl.query(
+        "db", "sp",
+        filters={"operator": operator, "conditions": conditions},
+        limit=limit)}
+
+
+def test_string_array_any_match(client):
+    got = _q(client, [{"field": "tags", "operator": "IN",
+                       "value": ["t1"]}])
+    assert got == {f"d{i}" for i in range(60) if i % 5 == 1}
+    # NOT IN on arrays: docs where NO element matches
+    got = _q(client, [{"field": "tags", "operator": "NOT IN",
+                       "value": ["t1", "t2"]}])
+    assert got == {f"d{i}" for i in range(60) if i % 5 not in (1, 2)}
+
+
+def test_date_range(client):
+    day = 86_400_000
+    got = _q(client, [
+        {"field": "born", "operator": ">=", "value": 10 * day},
+        {"field": "born", "operator": "<", "value": 13 * day},
+    ])
+    assert got == {"d10", "d11", "d12"}
+
+
+def test_long_in_and_or_combo(client):
+    got = _q(client, [{"field": "count", "operator": "IN",
+                       "value": [0, 100, 550, 590]}])
+    assert got == {"d0", "d10", "d55", "d59"}
+    got = _q(client, [
+        {"field": "count", "operator": "<", "value": 20},
+        {"field": "count", "operator": ">=", "value": 580},
+    ], operator="OR")
+    assert got == {"d0", "d1", "d58", "d59"}
+
+
+def test_bool_and_indexed_string(client):
+    got = _q(client, [
+        {"field": "ok", "operator": "=", "value": True},
+        {"field": "kind", "operator": "=", "value": "a"},
+    ])
+    # kind=a => i%3==0; ok => i%2==0 => i%6==0
+    assert got == {f"d{i}" for i in range(60) if i % 6 == 0}
+
+
+def test_filtered_search_combo_over_rest(client):
+    rng = np.random.default_rng(3)
+    vecs = rng.standard_normal((60, D)).astype(np.float32)
+    hits = client.search(
+        "db", "sp", [{"field": "emb", "feature": vecs[7].tolist()}],
+        limit=5,
+        filters={"operator": "AND", "conditions": [
+            {"field": "kind", "operator": "=", "value": "b"},
+            {"field": "count", "operator": ">=", "value": 70},
+        ]})
+    ids = [h["_id"] for h in hits[0]]
+    assert ids[0] == "d7"  # kind b (7%3==1), count 70: self-match allowed
+    assert all(int(i[1:]) % 3 == 1 and int(i[1:]) >= 7 for i in ids)
+
+
+def test_alias_document_ops(client):
+    import vearch_tpu.cluster.rpc as rpc
+
+    rpc.call(client.addr, "POST", "/alias/al1/dbs/db/spaces/sp")
+    docs = client.query("db", "al1", document_ids=["d5"])
+    assert docs[0]["kind"] == ["a", "b", "c"][5 % 3]
+    assert client.delete("db", "al1", document_ids=["d5"]) == 1
+    assert client.query("db", "al1", document_ids=["d5"]) == []
