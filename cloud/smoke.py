@@ -73,6 +73,15 @@ def wait_http(addr: str, deadline: float = 60.0) -> None:
 def main(data_dir: str) -> int:
     mports = free_ports(3)
     rports = free_ports(2)
+    gports = free_ports(2)
+    try:
+        import grpc as _grpc  # noqa: F401
+
+        has_grpc = subprocess.run(
+            ["protoc", "--version"], capture_output=True
+        ).returncode == 0
+    except Exception:
+        has_grpc = False
     peers = ",".join(f"{i + 1}=127.0.0.1:{p}" for i, p in enumerate(mports))
     master_list = ",".join(f"127.0.0.1:{p}" for p in mports)
     procs: dict[str, subprocess.Popen] = {}
@@ -95,6 +104,9 @@ def main(data_dir: str) -> int:
             procs[f"router{i + 1}"] = spawn([
                 "--role", "router", "--port", str(p),
                 "--master-addr", master_list,
+                # only when servable: a router crashes at startup if
+                # asked for gRPC without grpcio/protoc in the image
+                *(["--grpc-port", str(gports[i])] if has_grpc else []),
             ])
         r1 = VearchClient(f"127.0.0.1:{rports[0]}")
         r2 = VearchClient(f"127.0.0.1:{rports[1]}")
@@ -188,6 +200,55 @@ def main(data_dir: str) -> int:
                          limit=1)
         assert hits[0][0]["_id"] == "d7"
         print("smoke 3 OK: S3 backup/restore round-trip")
+
+        # 3b. round-4 surface: gRPC front door + online field index
+        if not has_grpc:
+            print("smoke 3b SKIP: grpcio/protoc not installed")
+        else:
+            import grpc as grpclib
+
+            from vearch_tpu.cluster.grpc_server import load_pb2
+
+            pb2 = load_pb2()
+            ch = grpclib.insecure_channel(f"127.0.0.1:{gports[0]}")
+            se = ch.unary_unary(
+                "/vearch_tpu.Router/Search",
+                request_serializer=pb2.SearchRequest.SerializeToString,
+                response_deserializer=pb2.SearchResponse.FromString,
+            )
+            resp = se(pb2.SearchRequest(
+                db_name="db", space_name="s",
+                vectors=[pb2.VectorQuery(field="v",
+                                         feature=vecs[7].tolist())],
+                limit=1), timeout=30)
+            assert resp.results[0].items[0].id == "d7"
+            ch.close()
+            print("smoke 3b OK: gRPC search through router1")
+        # 3c. online schema evolution: add a scalar field, index it
+        # live, filter on it — through the multi-master list
+        rpc.call(master_list, "PUT", "/dbs/db/spaces/s",
+                 {"fields": [{"name": "grade", "data_type": "integer"}]})
+        t0 = time.time()
+        while True:
+            try:
+                r1.upsert("db", "s", [{"_id": "g1", "grade": 7,
+                                       "v": vecs[0].tolist()}])
+                break
+            except RpcError:
+                # replicas can still be settling right after the
+                # restore swapped partition state under them
+                if time.time() - t0 > 30:
+                    raise
+                time.sleep(1.0)
+        rpc.call(master_list, "POST", "/field_index",
+                 {"db_name": "db", "space_name": "s", "field": "grade",
+                  "index_type": "INVERTED", "background": False})
+        docs = r1.query("db", "s", filters={
+            "operator": "AND", "conditions": [
+                {"operator": "=", "field": "grade", "value": 7}]},
+            limit=10)
+        assert [d["_id"] for d in docs] == ["g1"], docs
+        print("smoke 3c OK: live field addition + online field index")
 
         # 4. kill -9 one PS; replica_num=2 keeps every partition served
         procs["ps1"].send_signal(signal.SIGKILL)

@@ -301,3 +301,36 @@ def test_master_restart_reaps_stale_servers(tmp_path):
         assert rpc.call(m2.addr, "GET", "/servers")["servers"] == []
     finally:
         m2.stop()
+
+
+def test_write_after_restore_replicated(tmp_path, rng):
+    """Writes must work immediately after a restore on a replicated
+    partition. The restore resets every replica's log at the applied
+    horizon; the leader then has no term for the horizon index and —
+    before the fix — snapshot-looped forever instead of appending
+    (found by the cluster smoke's write-after-restore step)."""
+    store_root = str(tmp_path / "objectstore")
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((20, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(20)])
+        rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                 {"command": "create", "store_root": store_root})
+        rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                 {"command": "restore", "store_root": store_root,
+                  "version": 1})
+        cl.upsert("db", "s", [{"_id": "after", "v": vecs[0]}])
+        docs = cl.query("db", "s", document_ids=["after"])
+        assert docs and docs[0]["_id"] == "after"
+        # replication converged by append, not by snapshot churn
+        for ps in c.ps_nodes:
+            for node in ps.raft_nodes.values():
+                assert node.state()["snapshots_sent"] == 0

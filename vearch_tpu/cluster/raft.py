@@ -220,6 +220,18 @@ class RaftNode:
                 ni = self._next.get(peer, self.wal.last_index + 1)
                 prev = ni - 1
                 prev_term = self.wal.term_at(prev)
+                if prev_term is None and prev == self.wal.first_index - 1 \
+                        and prev <= self.applied:
+                    # prev is exactly OUR snapshot horizon (a restore or
+                    # install reset the log there). There is no entry to
+                    # read a term from, but the follower validates
+                    # horizon-covered prevs by index, not term
+                    # (handle_append's compacted-prev rule) — without
+                    # this case the leader snapshot-loops forever: each
+                    # install sets next to horizon+1 and the horizon
+                    # entry still has no term (found by the cluster
+                    # smoke's write-after-restore step).
+                    prev_term = -1
                 commit = self.commit
                 entries = self.wal.entries_from(ni) if prev_term is not None \
                     else []
@@ -380,6 +392,14 @@ class RaftNode:
                 else:
                     return {"success": False, "term": self.term,
                             "last_index": self.wal.last_index}
+            elif prev_t == -1:
+                # horizon sentinel: the leader's log was reset exactly at
+                # prev (restore/install) so it has no term to send, and
+                # prev is committed state on the leader. Match by index —
+                # truncating here could delete a COMMITTED local tail;
+                # any genuinely divergent suffix after prev is handled by
+                # the per-entry conflict rule below.
+                pass
             elif local_t != prev_t:
                 self.wal.truncate_suffix(prev_i)
                 return {"success": False, "term": self.term,
