@@ -281,3 +281,35 @@ def test_numeric_inverted_index_supports_range(cluster, client):
     )
     # prices cycle 0..49 over 200 docs: 4 full cycles x 5 values >= 45
     assert len(docs) == 20
+
+
+def test_online_field_index_survives_dump_load(tmp_path):
+    """An index added ONLINE must come back after dump + reopen: the
+    published flag rides schema.json and the rebuild is presence-gated."""
+    from vearch_tpu.engine.engine import Engine
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, ScalarIndexType,
+        TableSchema,
+    )
+
+    schema = TableSchema("t", [
+        FieldSchema("color", DataType.STRING),
+        FieldSchema("v", DataType.VECTOR, dimension=4,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema, data_dir=str(tmp_path / "d"))
+    eng.upsert([
+        {"_id": f"a{i}", "color": "red", "v": [float(i)] * 4}
+        for i in range(20)
+    ] + [{"_id": "noc", "v": [9.0] * 4}])  # color never set
+    eng.add_field_index("color", "BITMAP", background=False)
+    eng.dump(str(tmp_path / "d"))
+
+    eng2 = Engine.open(str(tmp_path / "d"))
+    assert eng2.schema.field("color").scalar_index \
+        is ScalarIndexType.BITMAP
+    assert eng2._scalar_manager is not None \
+        and eng2._scalar_manager.has_index("color")
+    docs = eng2.query({"operator": "AND", "conditions": [
+        {"operator": "=", "field": "color", "value": "red"}]}, limit=50)
+    assert len(docs) == 20  # the presence-gated row 'noc' is excluded
