@@ -1,0 +1,100 @@
+"""Randomized CLUSTER soak: the engine soak's shadow-model discipline
+driven through router REST against replicated partitions, with an
+online partition expansion and field-index flips mid-stream. Every
+mutation crosses the wire, the replicated log, and both replicas."""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.mark.slow
+def test_cluster_randomized_soak(tmp_path):
+    rng = np.random.default_rng(42)
+    with StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2) as c:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 2, "replica_num": 2,
+            "fields": [
+                {"name": "color", "data_type": "string"},
+                {"name": "v", "data_type": "vector", "dimension": D,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        shadow: dict[str, dict] = {}
+        colors = ["red", "green", "blue"]
+        next_id = 0
+        expanded = False
+
+        def check():
+            col = colors[int(rng.integers(0, 3))]
+            want = sum(1 for d in shadow.values() if d["color"] == col)
+            docs = cl.query("db", "s", filters={
+                "operator": "AND", "conditions": [
+                    {"operator": "=", "field": "color", "value": col}]},
+                limit=10_000)
+            got_ids = sorted(d["_id"] for d in docs)
+            want_ids = sorted(k for k, d in shadow.items()
+                              if d["color"] == col)
+            assert got_ids == want_ids, (col, len(got_ids), len(want_ids))
+            if shadow:
+                key = list(shadow)[int(rng.integers(0, len(shadow)))]
+                hits = cl.search("db", "s", [
+                    {"field": "v",
+                     "feature": shadow[key]["vec"].tolist()}], limit=1)
+                assert hits[0][0]["_id"] == key
+
+        for step in range(60):
+            op = rng.random()
+            if op < 0.45 or not shadow:
+                n = int(rng.integers(1, 6))
+                docs = []
+                for _ in range(n):
+                    if shadow and rng.random() < 0.3:
+                        key = list(shadow)[
+                            int(rng.integers(0, len(shadow)))]
+                    else:
+                        key = f"k{next_id}"
+                        next_id += 1
+                    vec = rng.standard_normal(D).astype(np.float32)
+                    color = colors[int(rng.integers(0, 3))]
+                    docs.append({"_id": key, "color": color, "v": vec})
+                    shadow[key] = {"color": color, "vec": vec}
+                cl.upsert("db", "s", docs)
+            elif op < 0.58:  # partial update through the cluster
+                key = list(shadow)[int(rng.integers(0, len(shadow)))]
+                color = colors[int(rng.integers(0, 3))]
+                cl.upsert("db", "s", [{"_id": key, "color": color}])
+                shadow[key]["color"] = color
+            elif op < 0.70:
+                key = list(shadow)[int(rng.integers(0, len(shadow)))]
+                assert cl.delete("db", "s", document_ids=[key]) == 1
+                del shadow[key]
+            elif op < 0.78:
+                sp = cl.get_space("db", "s")
+                color = next(f for f in sp["schema"]["fields"]
+                             if f["name"] == "color")
+                if color["scalar_index"] == "NONE":
+                    cl.add_field_index("db", "s", "color", "BITMAP",
+                                       background=False)
+                else:
+                    cl.remove_field_index("db", "s", "color")
+            elif op < 0.84:
+                cl.flush("db", "s")
+            elif op < 0.88 and not expanded and step > 20:
+                cl.update_space("db", "s", {"partition_num": 3})
+                expanded = True
+            else:
+                check()
+        check()
+        # exhaustive readback
+        docs = {d["_id"]: d for d in cl.query("db", "s", limit=10_000)}
+        assert set(docs) == set(shadow)
+        for key, d in shadow.items():
+            assert docs[key]["color"] == d["color"], key
