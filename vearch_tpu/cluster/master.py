@@ -662,8 +662,6 @@ class MasterServer:
                                                    drop=p["leader"]):
                         changed = True
             for p in sp["partitions"]:
-                if not may_replace:
-                    break
                 if len(p["replicas"]) >= replica_num:
                     continue
                 if p["leader"] not in servers:
@@ -674,10 +672,21 @@ class MasterServer:
                 ]
                 if not candidates:
                     continue
-                # least-loaded placement (reference: anti-affinity by
-                # node; fewest partitions wins)
-                target = min(candidates,
-                             key=lambda s: len(s.partition_ids))
+                # a RETURNING replica (the partition is already on its
+                # disk) rejoins immediately — recover_delay exists to
+                # avoid rebuilding data onto fresh nodes mid-restart,
+                # not to keep a restarted member out of its own group
+                returning = [s for s in candidates
+                             if p["id"] in s.partition_ids]
+                if returning:
+                    target = returning[0]
+                elif may_replace:
+                    # least-loaded placement (reference: anti-affinity
+                    # by node; fewest partitions wins)
+                    target = min(candidates,
+                                 key=lambda s: len(s.partition_ids))
+                else:
+                    continue
                 if self._add_replica(sp, p, target, servers):
                     changed = True
             if changed:

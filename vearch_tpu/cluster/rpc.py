@@ -22,8 +22,6 @@ import struct
 import threading
 import time
 import traceback
-import urllib.error
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
@@ -188,6 +186,9 @@ class JsonRpcServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # small JSON responses on kept-alive sockets must not sit in
+            # Nagle's buffer waiting for the client's delayed ACK
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):  # quiet
                 pass
@@ -310,13 +311,17 @@ class JsonRpcServer:
                 code = 0
                 prefix = self.path.split("?")[0]
                 try:
+                    # drain the request body BEFORE anything that can
+                    # raise (auth): with keep-alive clients an unread
+                    # body stays in the stream and desyncs the next
+                    # request on the pooled connection
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
                     if outer.authenticator is not None and not any(
                         prefix == p or prefix.startswith(p + "/")
                         for p in outer.auth_exempt
                     ):
                         outer.authenticator(self.headers, method, prefix)
-                    length = int(self.headers.get("Content-Length") or 0)
-                    raw = self.rfile.read(length) if length else b""
                     body = _decode(
                         self.headers.get("Content-Type") or JSON_CT, raw
                     )
@@ -477,7 +482,6 @@ def call(
                     raise
                 last = e
         raise last
-    url = f"http://{addr}{path}"
     if body is not None:
         ct, data = _encode(body)
     else:
@@ -488,24 +492,101 @@ def call(
     if auth is not None:
         token = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
         headers["Authorization"] = f"Basic {token}"
-    req = urllib.request.Request(
-        url, data=data, method=method, headers=headers,
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            payload = _decode(
-                resp.headers.get("Content-Type") or JSON_CT, resp.read()
-            )
-    except urllib.error.HTTPError as e:
+    status, resp_ct, raw = _pooled_request(addr, method, path, data,
+                                           headers, timeout)
+    if status >= 400:
         try:
-            payload = json.loads(e.read())
+            payload = json.loads(raw)
         except Exception:
-            raise RpcError(e.code, str(e)) from e
-    except urllib.error.URLError as e:
-        raise RpcError(-1, f"unreachable {addr}: {e}") from e
-    except OSError as e:
-        # connection reset mid-read surfaces as a bare OSError, not URLError
-        raise RpcError(-1, f"unreachable {addr}: {e}") from e
+            raise RpcError(status, f"HTTP {status}")
+    else:
+        payload = _decode(resp_ct, raw)
     if payload.get("code", 0) != 0:
         raise RpcError(payload["code"], payload.get("msg", "rpc error"))
     return payload.get("data")
+
+
+# -- keep-alive connection pool ---------------------------------------------
+# One pooled HTTPConnection per (thread, addr): profiling showed a fresh
+# TCP handshake per hop dominating small-request latency (client->router
+# ->PS = 3 connects per b=1 search). Connections are not thread-safe, so
+# the pool is thread-local; the server side already speaks HTTP/1.1 with
+# Content-Length responses, so keep-alive just works. A stale pooled
+# socket (peer restarted, idle timeout) gets ONE transparent retry on a
+# fresh connection.
+
+_conn_pool = threading.local()
+
+
+def _pooled_request(addr, method, path, data, headers, timeout):
+    import http.client
+
+    pool = getattr(_conn_pool, "conns", None)
+    if pool is None:
+        pool = _conn_pool.conns = {}
+    for attempt in (0, 1):
+        conn = pool.get(addr)
+        fresh = conn is None
+        if fresh:
+            host, _, port = addr.rpartition(":")
+            try:
+                conn = http.client.HTTPConnection(
+                    host, int(port), timeout=timeout)
+            except ValueError:
+                raise RpcError(-1, f"bad address {addr!r}") from None
+            pool[addr] = conn
+        elif conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        if conn.sock is None:
+            conn.timeout = timeout  # not the timeout it was created with
+            try:
+                conn.connect()
+            except OSError as e:
+                conn.close()
+                pool.pop(addr, None)
+                raise RpcError(-1, f"unreachable {addr}: {e}") from e
+            # keep-alive + small request/response pairs hit Nagle vs
+            # delayed-ACK (~40ms per hop on loopback); fresh-connection
+            # clients never noticed because the handshake reset timing
+            import socket as _socket
+
+            conn.sock.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+
+        def _drop():
+            conn.close()
+            pool.pop(addr, None)
+
+        # SEND phase: a send-side failure proves the request never
+        # executed, so retrying cannot duplicate a non-idempotent op
+        try:
+            conn.request(method, path, body=data, headers=headers)
+        except (BrokenPipeError, ConnectionResetError,
+                http.client.CannotSendRequest) as e:
+            _drop()
+            if fresh or attempt:
+                raise RpcError(-1, f"unreachable {addr}: {e}") from e
+            continue  # stale keep-alive socket: one fresh-connection retry
+        except (http.client.HTTPException, OSError) as e:
+            _drop()
+            raise RpcError(-1, f"unreachable {addr}: {e}") from e
+        # RECEIVE phase: only RemoteDisconnected (server closed without
+        # sending ANY response — the canonical idle-keep-alive reap) is
+        # retried; a timeout or mid-response error may mean the server
+        # is still executing the request, and re-sending would run a
+        # non-idempotent op twice
+        try:
+            resp = conn.getresponse()
+            raw = resp.read()
+        except http.client.RemoteDisconnected as e:
+            _drop()
+            if fresh or attempt:
+                raise RpcError(-1, f"unreachable {addr}: {e}") from e
+            continue
+        except (http.client.HTTPException, OSError) as e:
+            _drop()
+            raise RpcError(-1, f"unreachable {addr}: {e}") from e
+        ct = resp.headers.get("Content-Type") or JSON_CT
+        if resp.headers.get("Connection", "").lower() == "close":
+            _drop()
+        return resp.status, ct, raw
