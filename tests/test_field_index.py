@@ -313,3 +313,97 @@ def test_online_field_index_survives_dump_load(tmp_path):
     docs = eng2.query({"operator": "AND", "conditions": [
         {"operator": "=", "field": "color", "value": "red"}]}, limit=50)
     assert len(docs) == 20  # the presence-gated row 'noc' is excluded
+
+
+def test_online_index_rides_snapshot_catchup(tmp_path):
+    """A follower caught up via the chunked engine-snapshot stream must
+    come back with the online-added scalar index live (the snapshot is
+    the leader's dump: schema flag + presence-gated rebuild), and serve
+    correct filtered reads."""
+    import vearch_tpu.cluster.ps as ps_mod
+    from vearch_tpu.cluster import rpc as rpc_mod
+    from vearch_tpu.cluster.master import MasterServer
+    from vearch_tpu.cluster.ps import PSServer
+    from vearch_tpu.cluster.router import RouterServer
+
+    master = MasterServer(heartbeat_ttl=3600.0)
+    master.start()
+    nodes = [PSServer(data_dir=str(tmp_path / f"ps{i}"),
+                      master_addr=master.addr, heartbeat_interval=0.3,
+                      flush_interval=3600.0, raft_tick=0.3)
+             for i in range(2)]
+    for n in nodes:
+        n.start()
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    old_keep = ps_mod.WAL_KEEP_ENTRIES
+    ps_mod.WAL_KEEP_ENTRIES = 5
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [
+                {"name": "color", "data_type": "string"},
+                {"name": "emb", "data_type": "vector", "dimension": D,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        sp = cl.get_space("db", "s")["partitions"][0]
+        pid, leader_id = sp["id"], sp["leader"]
+        leader_ps = next(p for p in nodes if p.node_id == leader_id)
+        follower = next(p for p in nodes if p.node_id != leader_id)
+        rng = np.random.default_rng(6)
+        vecs = rng.standard_normal((60, D)).astype(np.float32)
+        cl.upsert("db", "s", [
+            {"_id": f"d{i}", "color": ["red", "blue"][i % 2],
+             "emb": vecs[i]} for i in range(20)
+        ])
+        cl.add_field_index("db", "s", "color", "BITMAP",
+                           background=False)
+
+        fdir = follower.data_dir
+        fid = follower.node_id
+        follower.stop(flush=False)
+        rpc_mod.call(master.addr, "POST", "/partitions/change_member",
+                     {"partition_id": pid, "node_id": fid,
+                      "method": "remove"})
+        for i in range(20, 60):
+            cl.upsert("db", "s", [
+                {"_id": f"d{i}", "color": ["red", "blue"][i % 2],
+                 "emb": vecs[i]}])
+        leader_ps.flush_partition(pid)
+
+        f2 = PSServer(data_dir=fdir, master_addr=master.addr,
+                      heartbeat_interval=0.3, raft_tick=0.3)
+        f2.start()
+        nodes.append(f2)
+        rpc_mod.call(master.addr, "POST", "/partitions/change_member",
+                     {"partition_id": pid, "node_id": fid,
+                      "method": "add"})
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (pid in f2.engines
+                    and f2.engines[pid].doc_count == 60):
+                break
+            time.sleep(0.3)
+        eng = f2.engines[pid]
+        assert eng.doc_count == 60, "snapshot catch-up did not converge"
+        assert eng._scalar_manager is not None \
+            and eng._scalar_manager.has_index("color"), \
+            "online index lost across snapshot install"
+        # filtered read served BY THE FOLLOWER is correct
+        docs = eng.query({"operator": "AND", "conditions": [
+            {"operator": "=", "field": "color", "value": "red"}]},
+            limit=200)
+        assert len(docs) == 30
+    finally:
+        ps_mod.WAL_KEEP_ENTRIES = old_keep
+        router.stop()
+        for n in nodes:
+            try:
+                n.stop(flush=False)
+            except Exception:
+                pass
+        master.stop()
