@@ -36,6 +36,8 @@ D = 8
 def shape_of(v):
     """Value -> deterministic structure: dicts keep keys, lists keep one
     element shape, scalars become type names."""
+    if isinstance(v, np.ndarray):
+        return "tensor"  # rides the binary codec, not JSON
     if isinstance(v, dict):
         return {k: shape_of(v[k]) for k in sorted(v)}
     if isinstance(v, (list, tuple)):

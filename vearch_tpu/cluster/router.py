@@ -692,6 +692,39 @@ class RouterServer:
             return []
         metric = partials[0]["metric"]
         reverse = metric != "L2"
+        if all(p.get("columnar") for p in partials):
+            # fields-free fast path: merge on raw key/score arrays and
+            # build ONLY the final top-k dicts for the client response
+            import numpy as np
+
+            nq = len(partials[0]["keys"])
+            # scores arrive as one flat buffer per partition; per-query
+            # slices are recovered from the key-list lengths and stay
+            # numpy until only the final top-k becomes Python objects
+            sliced = []
+            for p in partials:
+                flat = np.asarray(p["scores"])
+                offs = np.cumsum([0] + [len(ks) for ks in p["keys"]])
+                sliced.append([
+                    flat[offs[i]:offs[i + 1]] for i in range(nq)
+                ])
+            out = []
+            for qi in range(nq):
+                keys: list[str] = []
+                for p in partials:
+                    keys.extend(p["keys"][qi])
+                scores = np.concatenate([sc[qi] for sc in sliced])
+                # stable on the NEGATED array for descending order:
+                # reversing an ascending stable sort would invert tie
+                # order vs the legacy dict-row merge
+                order = np.argsort(-scores if reverse else scores,
+                                   kind="stable")[:k]
+                top = scores[order].tolist()
+                out.append([
+                    {"_id": keys[i], "_score": s}
+                    for i, s in zip(order.tolist(), top)
+                ])
+            return out
         nq = len(partials[0]["results"])
         out = []
         for qi in range(nq):

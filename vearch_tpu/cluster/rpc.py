@@ -33,42 +33,50 @@ from vearch_tpu.utils import log
 _log = log.get("rpc")
 
 JSON_CT = "application/json"
-BIN_CT = "application/x-vearch-tensors"
+# v2: path-directed tensor restore (header carries "paths"). The name is
+# bumped so a version-skewed OLD peer fails loudly on an unknown content
+# type instead of silently mis-restoring; THIS side still decodes v1
+# marker frames for the reverse skew.
+BIN_CT = "application/x-vearch-tensors2"
+BIN_CT_V1 = "application/x-vearch-tensors"
 _U32 = struct.Struct("<I")
 
 
-def _extract_tensors(obj: Any, out: list) -> Any:
-    """Replace ndarray leaves with placeholders, collecting buffers."""
+def _extract_tensors(obj: Any, out: list, paths: list, path: tuple) -> Any:
+    """Replace ndarray leaves with null placeholders, collecting the
+    buffers and their key-paths (so restore navigates straight to each
+    tensor instead of walking the whole tree)."""
     if isinstance(obj, np.ndarray):
-        idx = len(out)
         out.append(obj)
-        return {"__tensor__": idx}
+        paths.append(list(path))
+        return None
     if isinstance(obj, dict):
-        return {k: _extract_tensors(v, out) for k, v in obj.items()}
+        # record the POST-JSON key (always a string): the decoded tree
+        # the paths navigate has stringified keys
+        return {k: _extract_tensors(v, out, paths, path + (str(k),))
+                for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [_extract_tensors(v, out) for v in obj]
-    return obj
-
-
-def _restore_tensors(obj: Any, tensors: list[np.ndarray]) -> Any:
-    if isinstance(obj, dict):
-        if "__tensor__" in obj and len(obj) == 1:
-            return tensors[obj["__tensor__"]]
-        return {k: _restore_tensors(v, tensors) for k, v in obj.items()}
-    if isinstance(obj, list):
-        return [_restore_tensors(v, tensors) for v in obj]
+        return [_extract_tensors(v, out, paths, path + (i,))
+                for i, v in enumerate(obj)]
     return obj
 
 
 def _encode(body: Any) -> tuple[str, bytes]:
-    """JSON when tensor-free; binary framing otherwise."""
-    tensors: list[np.ndarray] = []
-    skeleton = _extract_tensors(body, tensors)
-    if not tensors:
+    """JSON when tensor-free; binary framing otherwise. The tensor-free
+    case is detected by letting json.dumps fail on the first ndarray —
+    pure-JSON bodies (the vast majority of control traffic and most
+    responses) serialize at C speed with no Python tree walk."""
+    try:
         return JSON_CT, json.dumps(body).encode()
+    except TypeError:
+        pass
+    tensors: list[np.ndarray] = []
+    paths: list[list] = []
+    skeleton = _extract_tensors(body, tensors, paths, ())
     arrays = [np.ascontiguousarray(t) for t in tensors]
     header = json.dumps({
         "body": skeleton,
+        "paths": paths,
         "tensors": [
             {"dtype": a.dtype.str, "shape": list(a.shape)} for a in arrays
         ],
@@ -78,10 +86,22 @@ def _encode(body: Any) -> tuple[str, bytes]:
     return BIN_CT, b"".join(parts)
 
 
+def _restore_markers_v1(obj: Any, tensors: list[np.ndarray]) -> Any:
+    """v1 compat: full-tree walk replacing {"__tensor__": i} markers."""
+    if isinstance(obj, dict):
+        if "__tensor__" in obj and len(obj) == 1:
+            return tensors[obj["__tensor__"]]
+        return {k: _restore_markers_v1(v, tensors) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore_markers_v1(v, tensors) for v in obj]
+    return obj
+
+
 def _decode(content_type: str, raw: bytes) -> Any:
     if not raw:
         return None
-    if not content_type.startswith(BIN_CT):
+    if not (content_type.startswith(BIN_CT)
+            or content_type.startswith(BIN_CT_V1)):
         return json.loads(raw)
     hlen = _U32.unpack_from(raw, 0)[0]
     header = json.loads(raw[4 : 4 + hlen])
@@ -97,7 +117,17 @@ def _decode(content_type: str, raw: bytes) -> Any:
         )
         off += nbytes
         tensors.append(arr)
-    return _restore_tensors(header["body"], tensors)
+    body = header["body"]
+    if "paths" not in header:
+        return _restore_markers_v1(body, tensors)
+    for path, arr in zip(header["paths"], tensors):
+        if not path:
+            return arr  # the body IS the tensor
+        node = body
+        for step in path[:-1]:
+            node = node[step]
+        node[path[-1]] = arr
+    return body
 
 
 class RpcError(Exception):

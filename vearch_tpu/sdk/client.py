@@ -84,10 +84,12 @@ class VearchClient:
         ranker: dict | None = None,
         load_balance: str = "leader",
     ) -> list[list[dict]]:
+        # features ride as ndarrays: the RPC layer's binary tensor codec
+        # ships a [b*d] f32 buffer instead of tens of thousands of JSON
+        # floats (a large-batch query upload was ~30% of e2e latency)
         vectors = [
-            {**v, "feature": (
-                np.asarray(v["feature"], dtype=np.float32).ravel().tolist()
-            )}
+            {**v, "feature": np.asarray(
+                v["feature"], dtype=np.float32).ravel()}
             for v in vectors
         ]
         body = {
