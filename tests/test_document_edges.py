@@ -289,3 +289,16 @@ def test_full_update_still_replaces_everything(client, vecs):
         [{"field": "emb", "feature": np.ones(D, dtype=np.float32)}],
         limit=1)
     assert hits[0][0]["_id"] == "d9"
+
+
+def test_columnar_response_matches_json(client, vecs):
+    """Opt-in columnar responses return exactly the JSON path's results
+    (ids AND scores) for fields-free searches."""
+    q = [{"field": "emb", "feature": vecs[:8]}]
+    plain = client.search("db", "sp", q, limit=5, fields=[])
+    col = client.search("db", "sp", q, limit=5, fields=[], columnar=True)
+    assert len(col) == 8
+    for a, b in zip(plain, col):
+        assert [r["_id"] for r in a] == [r["_id"] for r in b]
+        for ra, rb in zip(a, b):
+            assert abs(ra["_score"] - rb["_score"]) < 1e-4

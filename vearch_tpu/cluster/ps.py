@@ -880,7 +880,7 @@ class PSServer:
         )
         results = eng.search(req)
         metric = eng.indexes[next(iter(vectors))].metric.value
-        if body.get("include_fields") == []:
+        if body.get("columnar_wire") and body.get("include_fields") == []:
             # fields-free searches ride columnar: keys as string lists,
             # scores as ONE ndarray over the binary tensor codec —
             # per-item JSON dicts for b=1024*k results were a measured

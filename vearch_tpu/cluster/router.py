@@ -617,6 +617,10 @@ class RouterServer:
             "raft_consistent": bool(body.get("raft_consistent", False)),
             "filters": body.get("filters"),
             "include_fields": body.get("fields"),
+            # explicit opt-in to the internal columnar result shape: a
+            # version-skewed PS that ignores it just answers rows, and
+            # an old router never sends it (the merge handles both)
+            "columnar_wire": body.get("fields") == [],
             "index_params": body.get("index_params") or {},
             "trace": bool(body.get("trace", False)),
             "field_weights": {
@@ -668,7 +672,23 @@ class RouterServer:
             results = [f.result() for f in futures]
             partials = [r for _, r in results]
             merged = self._merge_search(partials, k)
-            out = {"documents": merged}
+            if body.get("columnar") and body.get("fields") == []:
+                # opt-in columnar response: the client gets key lists +
+                # ONE flat f32 score buffer over the binary codec
+                # instead of b*k JSON dicts (the SDK reshapes, so its
+                # return type is unchanged)
+                import numpy as np
+
+                out = {
+                    "columnar": True,
+                    "keys": [[r["_id"] for r in rows] for rows in merged],
+                    "scores": np.asarray(
+                        [r["_score"] for rows in merged for r in rows],
+                        dtype=np.float32,
+                    ),
+                }
+            else:
+                out = {"documents": merged}
             if root.trace_id:
                 # lets clients pull the span tree from /debug/traces on
                 # each role (reference: Jaeger trace id in responses)

@@ -33,11 +33,12 @@ from vearch_tpu.utils import log
 _log = log.get("rpc")
 
 JSON_CT = "application/json"
-# v2: path-directed tensor restore (header carries "paths"). The name is
-# bumped so a version-skewed OLD peer fails loudly on an unknown content
-# type instead of silently mis-restoring; THIS side still decodes v1
-# marker frames for the reverse skew.
-BIN_CT = "application/x-vearch-tensors2"
+# v2: path-directed tensor restore (header carries "paths"). The BASE
+# name changes (not a suffix — v1 peers match with startswith, so any
+# "...tensors<suffix>" would still be claimed by them and silently
+# mis-restored): an old peer seeing v2 falls to json.loads and fails
+# loudly. THIS side still decodes v1 marker frames for the reverse skew.
+BIN_CT = "application/x-vtensors2"
 BIN_CT_V1 = "application/x-vearch-tensors"
 _U32 = struct.Struct("<I")
 
@@ -61,15 +62,42 @@ def _extract_tensors(obj: Any, out: list, paths: list, path: tuple) -> Any:
     return obj
 
 
+def _probably_has_tensor(body: Any) -> bool:
+    """Shallow probe (two-ish levels) for ndarray leaves — catches the
+    hot shapes ({"scores": arr}, {"vectors": [{"feature": arr}]}) so
+    _encode skips the doomed json.dumps attempt instead of serializing
+    a large prefix just to throw it away."""
+    if isinstance(body, np.ndarray):
+        return True
+    if isinstance(body, dict):
+        vals = body.values()
+    elif isinstance(body, (list, tuple)):
+        vals = body[:4]
+    else:
+        return False
+    for v in vals:
+        if isinstance(v, np.ndarray):
+            return True
+        if isinstance(v, dict):
+            if any(isinstance(x, np.ndarray) for x in v.values()):
+                return True
+        elif isinstance(v, (list, tuple)):
+            if any(isinstance(x, (np.ndarray, dict))
+                   and _probably_has_tensor(x) for x in v[:4]):
+                return True
+    return False
+
+
 def _encode(body: Any) -> tuple[str, bytes]:
     """JSON when tensor-free; binary framing otherwise. The tensor-free
     case is detected by letting json.dumps fail on the first ndarray —
     pure-JSON bodies (the vast majority of control traffic and most
     responses) serialize at C speed with no Python tree walk."""
-    try:
-        return JSON_CT, json.dumps(body).encode()
-    except TypeError:
-        pass
+    if not _probably_has_tensor(body):
+        try:
+            return JSON_CT, json.dumps(body).encode()
+        except TypeError:
+            pass  # a deeply nested tensor the probe missed
     tensors: list[np.ndarray] = []
     paths: list[list] = []
     skeleton = _extract_tensors(body, tensors, paths, ())

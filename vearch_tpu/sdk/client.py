@@ -83,6 +83,7 @@ class VearchClient:
         index_params: dict | None = None,
         ranker: dict | None = None,
         load_balance: str = "leader",
+        columnar: bool = False,
     ) -> list[list[dict]]:
         # features ride as ndarrays: the RPC layer's binary tensor codec
         # ships a [b*d] f32 buffer instead of tens of thousands of JSON
@@ -105,6 +106,23 @@ class VearchClient:
             body["index_params"] = index_params
         if ranker:
             body["ranker"] = ranker
+        if columnar and fields == []:
+            # fields-free throughput mode: scores ride as ONE binary f32
+            # buffer instead of b*k JSON dicts; reshaped here so the
+            # return type is identical
+            body["columnar"] = True
+            out = rpc.call(self.addr, "POST", "/document/search", body)
+            if out.get("columnar"):
+                flat = np.asarray(out["scores"]).tolist()
+                res, pos = [], 0
+                for ks in out["keys"]:
+                    res.append([
+                        {"_id": k, "_score": flat[pos + i]}
+                        for i, k in enumerate(ks)
+                    ])
+                    pos += len(ks)
+                return res
+            return out["documents"]
         return rpc.call(self.addr, "POST", "/document/search", body)["documents"]
 
     def query(
