@@ -264,6 +264,13 @@ def test_multimaster_with_auth(tmp_path):
         for m in masters:
             out = rpc.call(m.addr, "GET", "/dbs", auth=root)
             assert [d["name"] for d in out["dbs"]] == ["authed"]
+        # heartbeat-fed GETs served on a follower forward to the leader
+        # WITH the caller's credentials (advisor r4: _leader_get used to
+        # drop the Authorization header and the leader 401'd these)
+        out = rpc.call(follower.addr, "GET", "/cluster/stats", auth=root)
+        assert "stats" in out
+        out = rpc.call(follower.addr, "GET", "/cluster/health", auth=root)
+        assert out["status"] in ("green", "yellow", "red")
     finally:
         for m in masters:
             m.stop()

@@ -72,8 +72,12 @@ def parse_resources(endpoint: str, method: str) -> tuple[str, str]:
     reads that ride POST."""
     privilege = PRIVI_READ if method == "GET" else PRIVI_WRITE
     e = endpoint
-    if (e.startswith("/cluster") or e == "/" or e.startswith("/members")
-            or e.startswith("/clean_lock")):
+    if e.startswith("/clean_lock"):
+        # rides GET but MUTATES state (clears expired space-mutation
+        # locks) — classify as a cluster write so a blanket ReadOnly
+        # grant cannot reach the ops escape hatch
+        return RESOURCE_CLUSTER, PRIVI_WRITE
+    if e.startswith("/cluster") or e == "/" or e.startswith("/members"):
         return RESOURCE_CLUSTER, privilege
     if (e.startswith("/servers") or e.startswith("/register")
             or e.startswith("/routers") or e.startswith("/schedule")):

@@ -70,6 +70,10 @@ class Space:
     # fan out instead of slot-routing (reference: expandPartitions,
     # space_service.go:792 — same re-carve, same consequence)
     expanded: bool = False
+    # partition ids that existed before the latest expansion — the only
+    # ones that can hold off-slot rows, so id-routed writes scope their
+    # existence probes to these instead of every partition
+    pre_expand_pids: list[int] = field(default_factory=list)
     # id->docid cache toggle (reference: entity/space.go:88-94). Kept
     # for wire compat: this engine holds the key->docid map in-process
     # (table.py _key_to_docid — no FFI boundary to cache across), so the
@@ -94,6 +98,8 @@ class Space:
             d["enable_id_cache"] = False
         if self.expanded:
             d["expanded"] = True
+        if self.pre_expand_pids:
+            d["pre_expand_pids"] = list(self.pre_expand_pids)
         return d
 
     @classmethod
@@ -110,6 +116,7 @@ class Space:
             anti_affinity=d.get("anti_affinity", "none"),
             enable_id_cache=bool(d.get("enable_id_cache", True)),
             expanded=bool(d.get("expanded", False)),
+            pre_expand_pids=[int(x) for x in d.get("pre_expand_pids", [])],
         )
 
     def slot_starts(self) -> list[int]:

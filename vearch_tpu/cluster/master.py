@@ -859,7 +859,11 @@ class MasterServer:
         hint = self.meta_node.leader_hint
         if hint is None or hint == self.node_id or hint not in self.peers:
             raise RpcError(503, "no metadata leader known yet")
-        return rpc.call(self.peers[hint], "GET", path)
+        # the caller's credentials must ride along (as _leader_proxy
+        # does) or the leader's authenticator 401s the forwarded GET
+        auth_hdr = rpc.current_auth_header()
+        extra = {"Authorization": auth_hdr} if auth_hdr else None
+        return rpc.call(self.peers[hint], "GET", path, extra_headers=extra)
 
     def _h_cluster_stats(self, _body, _parts) -> dict:
         """Per-node partition stats as last heartbeated (reference:
@@ -1203,6 +1207,15 @@ class MasterServer:
         old = space.partition_num
         space.partition_num = pn
         slots = carve_slots(pn)
+        # every partition that exists BEFORE this carve may hold rows
+        # that land off-slot under the new carve; record them so
+        # id-routed writes probe only these (new partitions can only
+        # hold correctly-slotted rows). Accumulates across repeated
+        # expansions: partitions added by an earlier expansion existed
+        # before this one.
+        pre = set(space.pre_expand_pids)
+        pre.update(p.id for p in space.partitions[:old])
+        space.pre_expand_pids = sorted(pre)
         # the group creator rolls back on failure, so re-carve the
         # existing partitions' slots only after the new ones exist —
         # a failed expansion must leave the old routing intact

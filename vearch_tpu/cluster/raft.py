@@ -212,6 +212,7 @@ class RaftNode:
 
     def _sync_peer_locked(self, peer: int) -> None:
         backoff_probes = 0
+        snap_sends = 0
         while not self._stopped:
             with self._lock:
                 if not self.is_leader:
@@ -219,27 +220,39 @@ class RaftNode:
                 term = self.term
                 ni = self._next.get(peer, self.wal.last_index + 1)
                 prev = ni - 1
+                # term_at answers at the compaction horizon too (the
+                # WAL persists horizon_term), so appends starting
+                # exactly at our snapshot horizon carry a REAL
+                # prev_term the follower can verify — index-only
+                # matching there would let a follower keep a divergent
+                # uncommitted entry at that index (Log Matching
+                # violation)
                 prev_term = self.wal.term_at(prev)
                 if prev_term is None and prev == self.wal.first_index - 1 \
                         and prev <= self.applied:
-                    # prev is exactly OUR snapshot horizon (a restore or
-                    # install reset the log there). There is no entry to
-                    # read a term from, but the follower validates
-                    # horizon-covered prevs by index, not term
-                    # (handle_append's compacted-prev rule) — without
-                    # this case the leader snapshot-loops forever: each
-                    # install sets next to horizon+1 and the horizon
-                    # entry still has no term (found by the cluster
-                    # smoke's write-after-restore step).
+                    # prev is OUR horizon but its term is unknown
+                    # (legacy meta / restored state). Snapshotting here
+                    # would loop forever — each install resets the
+                    # follower to this same unknowable horizon — so send
+                    # the sentinel. The FOLLOWER side is what makes this
+                    # safe: it index-matches -1 only when its own prev
+                    # is absent or committed, and nacks (never
+                    # truncates) an uncommitted local entry there, which
+                    # walks prev back until a real term or a genuine
+                    # behind-horizon snapshot resolves it.
                     prev_term = -1
                 commit = self.commit
                 entries = self.wal.entries_from(ni) if prev_term is not None \
                     else []
             if prev_term is None:
                 # the entry before next_index was compacted away: the
-                # follower is behind the log horizon -> full snapshot
-                # (reference: gammacb/snapshot.go file stream)
-                if not self._send_snapshot(peer, term):
+                # follower is genuinely behind the log horizon -> full
+                # snapshot (reference: gammacb/snapshot.go file stream).
+                # Safety valve: a snapshot must advance the follower; if
+                # repeated installs don't, stop this round rather than
+                # livelock re-streaming (the next tick retries).
+                snap_sends += 1
+                if snap_sends > 3 or not self._send_snapshot(peer, term):
                     return
                 continue
             try:
@@ -299,13 +312,17 @@ class RaftNode:
         if self.snapshot_fn is None:
             return False
         data, snap_index = self.snapshot_fn()
+        # term of the snapshot's last included entry — becomes the
+        # follower's horizon term so its subsequent appends at the
+        # horizon are term-verifiable
+        snap_term = self.wal.term_at(snap_index)
         sid = f"{self.node_id}-{time.time_ns()}"
         try:
             for off in range(0, max(len(data), 1), SNAP_CHUNK):
                 chunk = data[off : off + SNAP_CHUNK]
                 resp = self.send_fn(peer, f"{self.route_prefix}/snapshot", {
                     "pid": self.pid, "term": term, "sid": sid,
-                    "snap_index": snap_index,
+                    "snap_index": snap_index, "snap_term": snap_term,
                     "off": off, "total": len(data),
                     # raw bytes over the binary tensor codec (the
                     # reference streams raw 10MB chunks too)
@@ -393,13 +410,19 @@ class RaftNode:
                     return {"success": False, "term": self.term,
                             "last_index": self.wal.last_index}
             elif prev_t == -1:
-                # horizon sentinel: the leader's log was reset exactly at
-                # prev (restore/install) so it has no term to send, and
-                # prev is committed state on the leader. Match by index —
-                # truncating here could delete a COMMITTED local tail;
-                # any genuinely divergent suffix after prev is handled by
-                # the per-entry conflict rule below.
-                pass
+                # leader horizon sentinel (its prev term is unknowable).
+                # Index-match ONLY what is safe:
+                # - our entry at prev is committed -> identical to the
+                #   leader's committed history by raft safety, pass;
+                # - our entry is UNCOMMITTED -> it may diverge (advisor
+                #   r4: index-matching here is a Log Matching
+                #   violation). Nack with our commit index as the hint
+                #   so the leader walks prev back to term-verifiable
+                #   ground (or a real snapshot) — and never truncate
+                #   here: the entry might equally be a valid tail.
+                if prev_i > self.commit:
+                    return {"success": False, "term": self.term,
+                            "last_index": self.commit}
             elif local_t != prev_t:
                 self.wal.truncate_suffix(prev_i)
                 return {"success": False, "term": self.term,
@@ -608,11 +631,15 @@ class RaftNode:
                 return {"success": True, "term": self.term}
             del self._snap_in[sid]
         snap_index = int(body["snap_index"])
+        snap_term = body.get("snap_term")
         with self._apply_lock:
             if self.install_fn is not None:
                 self.install_fn(bytes(buf), snap_index)
             with self._lock:
-                self.wal.reset(snap_index + 1)
+                self.wal.reset(
+                    snap_index + 1,
+                    horizon_term=None if snap_term is None
+                    else int(snap_term))
                 self.wal.commit_index = snap_index
                 self.applied = snap_index
                 self.snapshots_installed += 1

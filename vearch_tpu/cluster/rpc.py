@@ -33,6 +33,19 @@ from vearch_tpu.utils import log
 _log = log.get("rpc")
 
 JSON_CT = "application/json"
+
+# Per-request context (the server is a ThreadingHTTPServer: one thread
+# per in-flight request). Handlers that make secondary RPCs on behalf of
+# the caller — e.g. a master follower forwarding a GET to the meta
+# leader — read the caller's credentials here so auth travels with the
+# forwarded call (reference: the BasicAuth header rides rpcx metadata).
+_request_ctx = threading.local()
+
+
+def current_auth_header() -> str | None:
+    """Authorization header of the request the current thread is
+    serving, or None outside a request."""
+    return getattr(_request_ctx, "auth", None)
 # v2: path-directed tensor restore (header carries "paths"). The BASE
 # name changes (not a suffix — v1 peers match with startswith, so any
 # "...tensors<suffix>" would still be claimed by them and silently
@@ -368,6 +381,7 @@ class JsonRpcServer:
                 t0 = time.time()
                 code = 0
                 prefix = self.path.split("?")[0]
+                _request_ctx.auth = self.headers.get("Authorization")
                 try:
                     # drain the request body BEFORE anything that can
                     # raise (auth): with keep-alive clients an unread
@@ -425,6 +439,7 @@ class JsonRpcServer:
                          "trace": traceback.format_exc(limit=8)},
                     )
                 finally:
+                    _request_ctx.auth = None
                     dt = time.time() - t0
                     # access log at debug (reference: request logs are
                     # debug-gated; IsDebugEnabled avoids the format cost)

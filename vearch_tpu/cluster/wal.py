@@ -36,6 +36,14 @@ class Wal:
         # tail is bounded by flush-truncation, so this stays modest
         self._entries: list[dict] = []
         self.first_index = 1  # index of the first entry retained in log
+        # term of the entry at first_index - 1 (the compaction/snapshot
+        # horizon). Persisted so a leader can always send a REAL
+        # prev_term for appends starting exactly at its horizon — the
+        # alternative (matching by index alone) lets a follower keep a
+        # divergent uncommitted entry at that index, a Log Matching
+        # violation. None = unknown (legacy meta): callers must fall
+        # back to snapshot install rather than trust the index.
+        self.horizon_term: int | None = 0
         self.term = 0
         self.commit_index = 0
         self.voted_for: int | None = None  # election mode only
@@ -53,6 +61,13 @@ class Wal:
             self.term = int(m.get("term", 0))
             self.commit_index = int(m.get("commit_index", 0))
             self.voted_for = m.get("voted_for")
+            if "horizon_term" in m:
+                ht = m["horizon_term"]
+                self.horizon_term = None if ht is None else int(ht)
+            else:
+                # legacy meta: the horizon term is only knowable when
+                # the log was never compacted (horizon = index 0)
+                self.horizon_term = 0 if self.first_index == 1 else None
 
     def save_meta(self, fsync: bool = False) -> None:
         with self._lock:
@@ -63,6 +78,7 @@ class Wal:
                     "term": self.term,
                     "commit_index": self.commit_index,
                     "voted_for": self.voted_for,
+                    "horizon_term": self.horizon_term,
                 }, f)
                 if fsync:
                     f.flush()
@@ -121,12 +137,18 @@ class Wal:
 
     def term_at(self, index: int) -> int | None:
         """Term of the entry at `index`; 0 for the sentinel before the
-        log; None when the entry has been truncated away or is beyond
-        the end."""
+        log; the persisted horizon term at first_index - 1; None when
+        the entry has been truncated away (and the horizon term is
+        unknown) or is beyond the end."""
         if index == 0:
             return 0
         e = self.get(index)
-        return None if e is None else int(e["term"])
+        if e is not None:
+            return int(e["term"])
+        with self._lock:
+            if index == self.first_index - 1:
+                return self.horizon_term
+        return None
 
     def entries_from(self, index: int, max_n: int = 512) -> list[dict]:
         with self._lock:
@@ -170,17 +192,26 @@ class Wal:
         with self._lock:
             if new_first <= self.first_index:
                 return
+            # record the term at the NEW horizon before the entry holding
+            # it is dropped (None only if new_first - 1 is itself already
+            # behind an unknown horizon)
+            self.horizon_term = self.term_at(new_first - 1)
             drop = min(new_first - self.first_index, len(self._entries))
             self._entries = self._entries[drop:]
             self.first_index = new_first
             self._rewrite()
 
-    def reset(self, first_index: int) -> None:
+    def reset(self, first_index: int,
+              horizon_term: int | None = None) -> None:
         """Clear the log entirely (after installing a snapshot at
-        first_index - 1)."""
+        first_index - 1). `horizon_term` is the term of the snapshot's
+        last included entry; None when the installer doesn't know it
+        (subsequent appends at the horizon then require a fresh
+        snapshot rather than index-matching)."""
         with self._lock:
             self._entries = []
             self.first_index = first_index
+            self.horizon_term = horizon_term
             self._rewrite()
 
     def _rewrite(self) -> None:
