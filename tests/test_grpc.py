@@ -253,3 +253,39 @@ def test_grpc_error_status_mapping(stack):
             vectors=[pb2.VectorQuery(field="emb", feature=[0.0] * D)],
             filters_json='"oops"'))
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_grpc_sort_json(stack):
+    """sort_json rides the gRPC surface into the same engine sort path
+    (reference: SortFields on the pb SearchRequest/QueryRequest)."""
+    router, cl, channel = stack
+    pb2 = load_pb2()
+    rng = np.random.default_rng(5)
+    search = _stub(channel, pb2, "Search", pb2.SearchRequest,
+                   pb2.SearchResponse)
+    resp = search(pb2.SearchRequest(
+        db_name="g", space_name="sp",
+        vectors=[pb2.VectorQuery(
+            field="emb",
+            feature=rng.standard_normal(D).astype(np.float32).tolist())],
+        limit=8, fields=["color"],
+        sort_json=json.dumps([{"color": "asc"}]),
+    ))
+    colors = [json.loads(it.fields_json)["color"]
+              for it in resp.results[0].items]
+    assert colors == sorted(colors)
+    query = _stub(channel, pb2, "Query", pb2.QueryRequest,
+                  pb2.QueryResponse)
+    qresp = query(pb2.QueryRequest(
+        db_name="g", space_name="sp", limit=50,
+        sort_json=json.dumps([{"color": "desc"}]),
+    ))
+    colors = [json.loads(d.fields_json)["color"] for d in qresp.documents]
+    assert colors == sorted(colors, reverse=True)
+    # invalid sort field maps to INVALID_ARGUMENT
+    with pytest.raises(grpc.RpcError) as e:
+        search(pb2.SearchRequest(
+            db_name="g", space_name="sp",
+            vectors=[pb2.VectorQuery(field="emb", feature=[0.0] * D)],
+            sort_json=json.dumps([{"nope": "asc"}])))
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT

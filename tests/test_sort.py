@@ -1,0 +1,279 @@
+"""Scalar-field result sort — engine, router merge, REST, pagination.
+
+Reference surface: sort parsing internal/ps/engine/sortorder/parse.go
+ParseSort; field validation doc_query.go:1329-1343; cross-partition
+merges client.go:779 SearchFieldSortExecute / :1062
+QueryFieldSortExecute with page_size/page_num slicing.
+"""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.rpc import RpcError
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.sort import compare_values, parse_sort, validate_sort
+from vearch_tpu.engine.types import (
+    DataType,
+    FieldSchema,
+    IndexParams,
+    MetricType,
+    TableSchema,
+)
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+# -- parse (reference: parse.go accepted forms) ------------------------------
+
+def test_parse_sort_forms():
+    assert parse_sort(None) == []
+    assert parse_sort("price") == [
+        {"field": "price", "desc": True, "missing_first": False}]
+    assert parse_sort("_score") == [
+        {"field": "_score", "desc": True, "missing_first": False}]
+    assert parse_sort("_id") == [
+        {"field": "_id", "desc": False, "missing_first": False}]
+    assert parse_sort([{"price": "asc"}]) == [
+        {"field": "price", "desc": False, "missing_first": False}]
+    assert parse_sort([{"price": {"order": "desc", "missing": "_first"}}]) \
+        == [{"field": "price", "desc": True, "missing_first": True}]
+    multi = parse_sort([{"a": "asc"}, {"b": "desc"}])
+    assert [s["field"] for s in multi] == ["a", "b"]
+
+
+@pytest.mark.parametrize("bad", [
+    42,
+    [{"a": "asc", "b": "desc"}],     # two fields in one spec
+    [{"a": "upward"}],               # bad order string
+    [{"a": {"order": "sideways"}}],  # bad order in full spec
+    [{"a": {"missing": "_middle"}}],
+    [3.14],
+])
+def test_parse_sort_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_sort(bad)
+
+
+def test_validate_sort_rejects_unknown_and_vector():
+    schema = {"price": "float", "emb": "vector"}
+    validate_sort(parse_sort("price"), schema)
+    validate_sort(parse_sort("_score"), schema)
+    with pytest.raises(ValueError, match="not space field"):
+        validate_sort(parse_sort("nope"), schema)
+    with pytest.raises(ValueError, match="vector field"):
+        validate_sort(parse_sort("emb"), schema)
+    with pytest.raises(ValueError, match="_score sort"):
+        validate_sort(parse_sort("_score"), schema, allow_score=False)
+
+
+def test_compare_values_missing_placement():
+    # missing sorts LAST in both directions by default
+    assert compare_values(None, 1, desc=False, missing_first=False) == 1
+    assert compare_values(None, 1, desc=True, missing_first=False) == 1
+    assert compare_values(1, None, desc=False, missing_first=False) == -1
+    # _first flips it, still direction-independent
+    assert compare_values(None, 1, desc=False, missing_first=True) == -1
+    assert compare_values(None, 1, desc=True, missing_first=True) == -1
+    assert compare_values(None, None, desc=False, missing_first=False) == 0
+
+
+# -- engine level ------------------------------------------------------------
+
+def _engine(n=30):
+    schema = TableSchema(name="t", fields=[
+        FieldSchema("price", DataType.FLOAT),
+        FieldSchema("count", DataType.INT),
+        FieldSchema("tag", DataType.STRING),
+        FieldSchema("emb", DataType.VECTOR, dimension=D,
+                    index=IndexParams(index_type="FLAT",
+                                      metric_type=MetricType.L2)),
+    ])
+    eng = Engine(schema)
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((n, D), dtype=np.float32)
+    docs = []
+    for i in range(n):
+        d = {"_id": f"d{i:03d}", "price": float(i % 7), "count": n - i,
+             "emb": vecs[i]}
+        if i % 3 != 0:
+            d["tag"] = f"tag{i % 5}"
+        docs.append(d)
+    eng.upsert(docs)
+    return eng, vecs
+
+
+def test_engine_search_sorted_by_field():
+    eng, vecs = _engine()
+    req = SearchRequest(vectors={"emb": vecs[0]}, k=10,
+                        sort=parse_sort([{"price": "asc"}]))
+    items = eng.search(req)[0].items
+    assert len(items) == 10
+    prices = [it.fields["price"] for it in items]
+    assert prices == sorted(prices)
+    # sort values attached in spec order
+    assert [it.sort_values for it in items] == [[p] for p in prices]
+    # ties (price repeats mod 7) break on score: the hit set is still
+    # the k-nearest by score, just reordered
+    desc = eng.search(SearchRequest(
+        vectors={"emb": vecs[0]}, k=10,
+        sort=parse_sort([{"price": "desc"}])))[0].items
+    assert {it.key for it in desc} == {it.key for it in items}
+    assert [it.fields["price"] for it in desc] == sorted(prices, reverse=True)
+
+
+def test_engine_query_sorted_numeric_and_string():
+    eng, _ = _engine()
+    # numeric asc (lexsort fast path)
+    docs = eng.query(limit=30, sort=parse_sort([{"count": "asc"}]))
+    counts = [d["count"] for d in docs]
+    assert counts == sorted(counts)
+    assert all(d["_sort"] == [d["count"]] for d in docs)
+    # numeric desc with _id tie-break on equal prices
+    docs = eng.query(limit=30, sort=parse_sort([{"price": "desc"}]))
+    pairs = [(-d["price"], d["_id"]) for d in docs]
+    assert pairs == sorted(pairs)
+    # string sort: docs lacking `tag` (every i % 3 == 0) sort last
+    docs = eng.query(limit=30, sort=parse_sort([{"tag": "asc"}]))
+    tags = [d.get("tag") for d in docs]
+    n_missing = sum(1 for t in tags if t is None)
+    assert n_missing == 10
+    assert all(t is None for t in tags[-n_missing:])
+    present = [t for t in tags if t is not None]
+    assert present == sorted(present)
+    # missing_first flips the block
+    docs = eng.query(limit=30, sort=parse_sort(
+        [{"tag": {"order": "asc", "missing": "_first"}}]))
+    tags = [d.get("tag") for d in docs]
+    assert all(t is None for t in tags[:n_missing])
+
+
+def test_engine_query_sort_pagination_window():
+    eng, _ = _engine()
+    full = eng.query(limit=30, sort=parse_sort([{"count": "asc"}]))
+    page = eng.query(limit=5, offset=10, sort=parse_sort([{"count": "asc"}]))
+    assert [d["_id"] for d in page] == [d["_id"] for d in full[10:15]]
+
+
+def test_engine_multi_key_sort():
+    eng, _ = _engine()
+    docs = eng.query(limit=30, sort=parse_sort(
+        [{"price": "asc"}, {"count": "desc"}]))
+    keys = [(d["price"], -d["count"]) for d in docs]
+    assert keys == sorted(keys)
+    assert docs[0]["_sort"] == [docs[0]["price"], docs[0]["count"]]
+
+
+# -- cluster level (multi-partition merge + REST errors) ---------------------
+
+@pytest.fixture(scope="module")
+def sort_cluster(tmp_path_factory):
+    c = StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("sortcluster")), n_ps=2
+    )
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("sdb")
+    cl.create_space("sdb", {
+        "name": "ss", "partition_num": 3, "replica_num": 1,
+        "fields": [
+            {"name": "price", "data_type": "float"},
+            {"name": "rank", "data_type": "integer"},
+            {"name": "emb", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    rng = np.random.default_rng(11)
+    vecs = rng.standard_normal((60, D), dtype=np.float32)
+    docs = [
+        {"_id": f"k{i:03d}", "price": float(i % 9), "rank": i,
+         "emb": vecs[i].tolist()}
+        for i in range(60)
+    ]
+    cl.upsert("sdb", "ss", docs)
+    yield c, cl, vecs
+    c.stop()
+
+
+def test_cluster_query_sort_merges_across_partitions(sort_cluster):
+    _, cl, _ = sort_cluster
+    docs = cl.query("sdb", "ss", limit=60, sort=[{"rank": "desc"}])
+    assert [d["rank"] for d in docs] == list(range(59, -1, -1))
+    # duplicate sort keys (price mod 9): global order ties break on _id
+    # -> deterministic, partition-count independent (merge stability)
+    docs = cl.query("sdb", "ss", limit=60, sort=[{"price": "asc"}])
+    pairs = [(d["price"], d["_id"]) for d in docs]
+    assert pairs == sorted(pairs)
+
+
+def test_cluster_query_sort_pagination_walk(sort_cluster):
+    _, cl, _ = sort_cluster
+    full = cl.query("sdb", "ss", limit=60, sort=[{"rank": "asc"}])
+    walked = []
+    for off in range(0, 60, 7):
+        walked.extend(cl.query("sdb", "ss", limit=7, offset=off,
+                               sort=[{"rank": "asc"}]))
+    assert [d["_id"] for d in walked] == [d["_id"] for d in full]
+
+
+def test_cluster_search_sort_by_field(sort_cluster):
+    _, cl, vecs = sort_cluster
+    res = cl.search("sdb", "ss", [{"field": "emb", "feature": vecs[5]}],
+                    limit=12, sort=[{"rank": "asc"}])
+    items = res[0]
+    assert len(items) == 12
+    ranks = [it["rank"] for it in items]
+    assert ranks == sorted(ranks)
+    assert all("_sort" in it for it in items)
+    # the hit SET matches the unsorted top-12 (sort reorders, it does
+    # not change candidate selection — reference search semantics)
+    plain = cl.search("sdb", "ss", [{"field": "emb", "feature": vecs[5]}],
+                      limit=12)
+    assert {it["_id"] for it in items} == {it["_id"] for it in plain[0]}
+
+
+def test_cluster_search_sort_pagination(sort_cluster):
+    _, cl, vecs = sort_cluster
+    q = [{"field": "emb", "feature": vecs[9]}]
+    full = cl.search("sdb", "ss", q, limit=20, sort=[{"rank": "asc"}])[0]
+    p1 = cl.search("sdb", "ss", q, limit=20, sort=[{"rank": "asc"}],
+                   page_size=8, page_num=1)[0]
+    p2 = cl.search("sdb", "ss", q, limit=20, sort=[{"rank": "asc"}],
+                   page_size=8, page_num=2)[0]
+    assert [d["_id"] for d in p1] == [d["_id"] for d in full[:8]]
+    assert [d["_id"] for d in p2] == [d["_id"] for d in full[8:16]]
+
+
+def test_cluster_sort_projection_autoincludes_field(sort_cluster):
+    _, cl, vecs = sort_cluster
+    # explicit non-empty projection missing the sort field: the field is
+    # auto-added so its values come back (reference doc_query.go:1337)
+    res = cl.search("sdb", "ss", [{"field": "emb", "feature": vecs[3]}],
+                    limit=5, fields=["price"], sort=[{"rank": "asc"}])
+    assert all("rank" in it for it in res[0])
+
+
+def test_cluster_sort_error_cases(sort_cluster):
+    _, cl, vecs = sort_cluster
+    q = [{"field": "emb", "feature": vecs[0]}]
+    with pytest.raises(RpcError, match="not space field"):
+        cl.search("sdb", "ss", q, limit=3, sort=[{"nope": "asc"}])
+    with pytest.raises(RpcError, match="vector field"):
+        cl.search("sdb", "ss", q, limit=3, sort=[{"emb": "asc"}])
+    with pytest.raises(RpcError, match="invalid sort order"):
+        cl.search("sdb", "ss", q, limit=3, sort=[{"price": "upward"}])
+    with pytest.raises(RpcError, match="_score sort"):
+        cl.query("sdb", "ss", filters=None, limit=3, sort="_score")
+
+
+def test_cluster_query_by_ids_sort(sort_cluster):
+    """sort on the document_ids path overrides request order and still
+    validates (review r5: it used to be silently ignored there)."""
+    _, cl, _ = sort_cluster
+    ids = ["k007", "k003", "k011", "k001"]
+    docs = cl.query("sdb", "ss", document_ids=ids, sort=[{"rank": "desc"}])
+    assert [d["_id"] for d in docs] == ["k011", "k007", "k003", "k001"]
+    with pytest.raises(RpcError, match="not space field"):
+        cl.query("sdb", "ss", document_ids=ids, sort=[{"nope": "asc"}])

@@ -87,8 +87,10 @@ def _loads(s: str, what: str, want: type = dict) -> Any:
     except ValueError:
         raise RpcError(400, f"invalid JSON in {what}") from None
     if not isinstance(out, want):
+        names = (want.__name__ if isinstance(want, type)
+                 else "/".join(t.__name__ for t in want))
         raise RpcError(
-            400, f"{what} must be a JSON {want.__name__}, "
+            400, f"{what} must be a JSON {names}, "
                  f"got {type(out).__name__}")
     return out
 
@@ -216,6 +218,9 @@ class GrpcRouter:
             body["ranker"] = _loads(req.ranker_json, "ranker_json")
         if req.load_balance:
             body["load_balance"] = req.load_balance
+        if req.sort_json:
+            body["sort"] = _loads(req.sort_json, "sort_json",
+                                  want=(dict, list, str))
         if req.trace:
             body["trace"] = True
         out = self.router._h_search(body, None)
@@ -248,6 +253,9 @@ class GrpcRouter:
             body["fields"] = list(req.fields)
         if req.vector_value:
             body["vector_value"] = True
+        if req.sort_json:
+            body["sort"] = _loads(req.sort_json, "sort_json",
+                                  want=(dict, list, str))
         out = self.router._h_query(body, None)
         resp = self.pb2.QueryResponse()
         for doc in out["documents"]:

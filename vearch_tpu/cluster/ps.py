@@ -875,6 +875,7 @@ class PSServer:
             score_bounds={
                 f: tuple(b) for f, b in body["score_bounds"].items()
             } if body.get("score_bounds") else None,
+            sort=body.get("sort") or None,
             trace=trace,
             ctx=ctx,
         )
@@ -901,7 +902,10 @@ class PSServer:
                 "metric": metric,
                 "results": [
                     [
-                        {"_id": it.key, "_score": it.score, **it.fields}
+                        {"_id": it.key, "_score": it.score,
+                         **({"_sort": it.sort_values}
+                            if it.sort_values is not None else {}),
+                         **it.fields}
                         for it in r.items
                     ]
                     for r in results
@@ -924,6 +928,7 @@ class PSServer:
                 offset=int(body.get("offset", 0)),
                 include_fields=body.get("fields"),
                 vector_value=vv,
+                sort=body.get("sort") or None,
             )
         return {"documents": docs}
 
@@ -1088,8 +1093,12 @@ class PSServer:
                 with self._lock:
                     self.engines[pid] = restored
                 # restored state supersedes the log: reset it at the
-                # current applied horizon (a point-in-time rewind)
-                node.wal.reset(node.wal.last_index + 1)
+                # current applied horizon (a point-in-time rewind).
+                # last_term is the term AT last_index, so the horizon
+                # stays term-verifiable for subsequent appends
+                horizon_term = node.wal.term_at(node.wal.last_index)
+                node.wal.reset(node.wal.last_index + 1,
+                               horizon_term=horizon_term)
                 node.applied = node.wal.last_index
                 node.wal.commit_index = node.wal.last_index
                 node.wal.save_meta(fsync=True)

@@ -66,6 +66,11 @@ class SearchRequest:
     # ones (reference: min_score/max_score per vector query,
     # test_document_search.py test_..._with_score_filter)
     score_bounds: dict[str, tuple] | None = None
+    # normalized scalar-field sort specs (engine/sort.py parse_sort):
+    # hits get per-spec sort values attached and each query's items are
+    # returned ordered by them (reference: SortFields on the request,
+    # doc_query.go:1543; sortorder value compare)
+    sort: list[dict] | None = None
     # when not None, the engine records per-phase wall times into it
     # (reference: per-request trace:true timing breakdown,
     # client/client.go:521-565 + PerfTool, index_model.h:24)
@@ -326,6 +331,7 @@ class Engine:
         include_fields: list[str] | None = None,
         vector_value: bool = False,
         order_by_key: bool = True,
+        sort: list[dict] | None = None,
     ) -> list[dict]:
         """Scalar-only query: filter docs without vector search
         (reference: engine.cc:404 ScalarIndexQuery-only path +
@@ -335,6 +341,10 @@ class Engine:
         merge-then-slice global pagination is correct regardless of
         insertion order; pass order_by_key=False for drain-style callers
         (delete-by-filter) that don't care and shouldn't pay the sort.
+        With `sort` (normalized specs, engine/sort.py), matches order by
+        the scalar sort keys instead — _id tie-break — and each returned
+        doc carries "_sort" values for the router's cross-partition
+        merge (reference: QueryFieldSortExecute, client.go:1062).
         """
         n = self.table.doc_count
         valid = self.bitmap.valid_mask(n)
@@ -343,14 +353,17 @@ class Engine:
 
             valid = valid & evaluate_filter(filters, self, n)
         matched = np.nonzero(valid)[0]
-        if order_by_key and matched.size:
+        sort_rows: list[list] | None = None
+        if sort and matched.size:
+            matched, sort_rows = self._sorted_matches(matched, sort)
+        elif order_by_key and matched.size:
             keys = np.array(
                 [self.table.key_of(int(i)) for i in matched], dtype=object
             )
             matched = matched[np.argsort(keys, kind="stable")]
         hits = matched[offset : offset + limit]
         out = []
-        for docid in hits:
+        for pos, docid in enumerate(hits):
             docid = int(docid)
             doc = {"_id": self.table.key_of(docid)}
             doc.update(self.table.get_fields(docid, include_fields))
@@ -359,8 +372,77 @@ class Engine:
                     include_fields is not None and name in include_fields
                 ):
                     doc[name] = store.get(docid).tolist()
+            if sort_rows is not None:
+                doc["_sort"] = sort_rows[offset + pos]
             out.append(doc)
         return out
+
+    def _sorted_matches(
+        self, matched: np.ndarray, specs: list[dict]
+    ) -> tuple[np.ndarray, list[list]]:
+        """Order matched docids by the sort specs (stable, _id
+        tie-break). Returns (ordered docids, per-docid sort values in
+        the SAME order). Fixed numeric columns ride a vectorised
+        np.lexsort; string/missing-capable fields fall back to a cmp
+        sort."""
+        from vearch_tpu.engine.sort import ID_FIELD, SCORE_FIELD, row_sort_key
+
+        ids = matched.tolist()
+        keys = [self.table.key_of(int(i)) for i in ids]
+        value_cols: list[list] = []
+        all_fixed = True
+        for s in specs:
+            f = s["field"]
+            if f == ID_FIELD:
+                value_cols.append(keys)
+                all_fixed = False
+                continue
+            if f == SCORE_FIELD:
+                # no vector score in a scalar query; router rejects this
+                # upstream, a direct caller gets None values (sort last)
+                value_cols.append([None] * len(ids))
+                all_fixed = False
+                continue
+            try:
+                col = self.table.column(f)
+                value_cols.append(col[matched].tolist())
+            except KeyError:
+                # string (or unknown) field: per-doc lookup, None when
+                # the doc lacks it
+                all_fixed = False
+                try:
+                    scol = self.table.string_column(f)
+                    value_cols.append([scol[i] for i in ids])
+                except KeyError:
+                    value_cols.append([None] * len(ids))
+        if all_fixed and value_cols:
+            # numeric fast path: lexsort with least-significant key
+            # first -> feed (tie-break key, reversed spec columns)
+            import numpy as _np
+
+            # least-significant key first: (_id tie-break, then spec
+            # columns in reverse). Keys are str -> unicode dtype
+            # (np.lexsort rejects object arrays).
+            lex_keys = [_np.asarray(keys)]
+            for s, col in zip(reversed(specs), reversed(value_cols)):
+                arr = _np.asarray(col)
+                if arr.dtype == bool or arr.dtype.kind == "u":
+                    arr = arr.astype(_np.int64)  # negate-safe
+                lex_keys.append(-arr if s["desc"] else arr)
+            order = _np.lexsort(lex_keys)
+        else:
+            rows = list(range(len(ids)))
+            rows.sort(key=row_sort_key(
+                specs,
+                lambda r: [value_cols[c][r] for c in range(len(specs))],
+                tie_key=lambda r: keys[r],
+            ))
+            order = rows
+        ordered = matched[np.asarray(order, dtype=np.int64)]
+        sort_rows = [
+            [value_cols[c][r] for c in range(len(specs))] for r in order
+        ]
+        return ordered, sort_rows
 
     # -- index lifecycle -----------------------------------------------------
 
@@ -891,18 +973,84 @@ class Engine:
             else [{}] * len(keys)
         )
         flat_scores = metric_scores[ok].tolist()
+        sort_rows = self._sort_value_rows(
+            req.sort, flat_ids, keys, flat_scores,
+            fields_list if want_fields else None, req.include_fields)
         counts = ok.sum(axis=1).tolist()
         results = []
         pos = 0
         for c in counts:
             items = [
                 SearchResultItem(key=keys[j], score=float(flat_scores[j]),
-                                 fields=fields_list[j])
+                                 fields=fields_list[j],
+                                 sort_values=sort_rows[j]
+                                 if sort_rows is not None else None)
                 for j in range(pos, pos + c)
             ]
+            if req.sort:
+                self._order_items(items, req.sort, metric)
             results.append(SearchResult(items=items))
             pos += c
         return results
+
+    def _sort_value_rows(
+        self, specs: list[dict] | None, flat_ids: np.ndarray,
+        keys: list[str], flat_scores: list[float],
+        fields_list: list[dict] | None,
+        include_fields: list[str] | None,
+    ) -> list[list] | None:
+        """Per-hit sort-value lists (spec order) for the whole flat
+        batch. _score and _id come from the hit itself; scalar fields
+        are read from the already-gathered projection when it covers
+        them (the router auto-adds sort fields to non-empty
+        projections, so the common case pays zero extra gathers) and
+        fetched in one extra gather only for fields==[] requests."""
+        if not specs:
+            return None
+        from vearch_tpu.engine.sort import ID_FIELD, SCORE_FIELD
+
+        covered = (fields_list is not None
+                   and (include_fields is None
+                        or set(include_fields).issuperset(
+                            s["field"] for s in specs
+                            if s["field"] not in (ID_FIELD, SCORE_FIELD))))
+        if covered:
+            field_rows = fields_list
+        else:
+            scalar_fields = [s["field"] for s in specs
+                             if s["field"] not in (ID_FIELD, SCORE_FIELD)]
+            field_rows = (
+                self.table.gather_rows(flat_ids, scalar_fields)
+                if scalar_fields else [{}] * len(keys)
+            )
+        out = []
+        for j in range(len(keys)):
+            row = []
+            for s in specs:
+                f = s["field"]
+                if f == SCORE_FIELD:
+                    row.append(flat_scores[j])
+                elif f == ID_FIELD:
+                    row.append(keys[j])
+                else:
+                    row.append(field_rows[j].get(f))
+            out.append(row)
+        return out
+
+    def _order_items(self, items: list, specs: list[dict], metric) -> None:
+        """In-place order of one query's hits by the sort spec; ties
+        break on metric-oriented score (L2 ascending, IP/cosine
+        descending) then key, so the order is deterministic and
+        partition-merge-stable."""
+        from vearch_tpu.engine.sort import row_sort_key
+        from vearch_tpu.engine.types import MetricType
+
+        l2 = metric is MetricType.L2
+        items.sort(key=row_sort_key(
+            specs,
+            lambda it: it.sort_values,
+            tie_key=lambda it: ((it.score if l2 else -it.score), it.key),
+        ))
 
     # -- persistence (reference: engine.cc:1217 Dump / :1293 Load) ----------
 

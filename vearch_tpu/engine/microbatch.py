@@ -66,6 +66,9 @@ def _compat_key(req: "SearchRequest") -> str:
         # silently drop (or wrongly apply) the score window
         "bounds": {f: list(b) for f, b in sorted(req.score_bounds.items())}
         if req.score_bounds else None,
+        # sort reorders each query's items; co-batching mixed sorts
+        # would order one caller's hits under another's spec
+        "sort": req.sort or None,
     }, sort_keys=True, default=str)
 
 

@@ -178,11 +178,15 @@ class TableSchema:
 
 @dataclass
 class SearchResultItem:
-    """One hit: doc key, score, optional fields payload."""
+    """One hit: doc key, score, optional fields payload, and — when the
+    request carried a `sort` spec — the hit's sort values in spec order
+    (reference: response/doc_results.go SortValues; the router merges on
+    these without re-deriving them from fields)."""
 
     key: str
     score: float
     fields: dict[str, Any] = field(default_factory=dict)
+    sort_values: list | None = None
 
 
 @dataclass

@@ -84,6 +84,9 @@ class VearchClient:
         ranker: dict | None = None,
         load_balance: str = "leader",
         columnar: bool = False,
+        sort: Any = None,
+        page_size: int | None = None,
+        page_num: int | None = None,
     ) -> list[list[dict]]:
         # features ride as ndarrays: the RPC layer's binary tensor codec
         # ships a [b*d] f32 buffer instead of tens of thousands of JSON
@@ -106,6 +109,12 @@ class VearchClient:
             body["index_params"] = index_params
         if ranker:
             body["ranker"] = ranker
+        if sort is not None:
+            body["sort"] = sort
+        if page_size is not None:
+            body["page_size"] = page_size
+        if page_num is not None:
+            body["page_num"] = page_num
         if columnar and fields == []:
             # fields-free throughput mode: scores ride as ONE binary f32
             # buffer instead of b*k JSON dicts; reshaped here so the
@@ -135,6 +144,7 @@ class VearchClient:
         offset: int = 0,
         fields: list[str] | None = None,
         vector_value: bool = False,
+        sort: Any = None,
     ) -> list[dict]:
         body: dict[str, Any] = {"db_name": db_name, "space_name": space_name,
                                 "limit": limit, "offset": offset,
@@ -145,6 +155,8 @@ class VearchClient:
             body["filters"] = filters
         if fields is not None:
             body["fields"] = fields
+        if sort is not None:
+            body["sort"] = sort
         return rpc.call(self.addr, "POST", "/document/query", body)["documents"]
 
     def delete(
