@@ -287,8 +287,68 @@ def main():
             times.append(time.time() - t0)
         lat[b] = min(times)
 
-    # recall gate vs exact bf16 scan on device
+    # -- per-phase breakdown (r4 review next-1: the captured headline
+    # must be decomposable — where does the wall time go?) ------------
+    from vearch_tpu.ops import ivf as ivf_ops
+    from vearch_tpu.ops.distance import to_device_mask
+
     store = eng.vector_stores["emb"]
+    approx8, mscale, mvsq = idx._mirror.flush()
+    basebuf, base_sqn, _ = store.device_buffer()
+    dvalid = to_device_mask(None, idx.indexed_count, approx8.shape[0])
+    rdepth = min(idx._rerank_depth(10, {"rerank": 128}),
+                 max(idx.indexed_count, 1))
+    qhost = np.ascontiguousarray(queries[:batch])
+
+    def _best(fn, reps=3):
+        times = []
+        for _ in range(reps):
+            t = time.time()
+            fn()
+            times.append(time.time() - t)
+        return min(times)
+
+    qdev = jnp.asarray(qhost)
+    qdev.block_until_ready()
+    t_h2d = _best(lambda: jnp.asarray(
+        np.array(qhost)).block_until_ready())
+    cand = ivf_ops.int8_scan_candidates(
+        qdev, approx8, mscale, mvsq, dvalid, rdepth,
+        MetricType.L2, "auto")
+    jax.block_until_ready(cand)
+    t_scan = _best(lambda: jax.block_until_ready(
+        ivf_ops.int8_scan_candidates(
+            qdev, approx8, mscale, mvsq, dvalid, rdepth,
+            MetricType.L2, "auto")))
+    cand_i = cand[1]
+    t_rerank = _best(lambda: jax.block_until_ready(
+        ivf_ops.exact_rerank(qdev.astype(basebuf.dtype), cand_i,
+                             basebuf, base_sqn, 10, MetricType.L2)))
+    fused_out = ivf_ops.int8_scan_rerank(
+        qdev, approx8, mscale, mvsq, dvalid, basebuf, base_sqn,
+        rdepth, 10, MetricType.L2, MetricType.L2, "auto",
+        idx.mirror_storage)
+    jax.block_until_ready(fused_out)
+    t_fused = _best(lambda: jax.block_until_ready(
+        ivf_ops.int8_scan_rerank(
+            qdev, approx8, mscale, mvsq, dvalid, basebuf, base_sqn,
+            rdepth, 10, MetricType.L2, MetricType.L2, "auto",
+            idx.mirror_storage)))
+    t_d2h = _best(lambda: jax.device_get(fused_out))
+    t_python = max(dt - (t_h2d + t_fused + t_d2h), 0.0)
+    phase_ms = {
+        "h2d_query": round(t_h2d * 1e3, 2),
+        "kernel_scan": round(t_scan * 1e3, 2),
+        "kernel_rerank": round(t_rerank * 1e3, 2),
+        "kernel_fused_scan_rerank": round(t_fused * 1e3, 2),
+        "d2h_topk": round(t_d2h * 1e3, 2),
+        "python_engine_overhead": round(t_python * 1e3, 2),
+        "e2e_engine": round(dt * 1e3, 2),
+        "kernel_frac_of_e2e": round(t_fused / dt, 3) if dt else 0.0,
+        "dispatches_per_search": 1,
+    }
+
+    # recall gate vs exact bf16 scan on device
     buf, sqn, _ = store.device_buffer()
     bs, bi = brute_force_search(
         jnp.asarray(queries[:batch], jnp.bfloat16), buf, None, 10,
@@ -314,6 +374,7 @@ def main():
         result["dryrun"] = True
     diag = {
         "recall_at_10": round(recall, 4),
+        "phase_ms": phase_ms,
         **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),
         "latency_ms_b1": round(lat[1] * 1e3, 1),

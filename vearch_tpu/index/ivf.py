@@ -526,11 +526,34 @@ class IVFPQIndex(_IVFBase):
             topk_mode = (params or {}).get(
                 "topk_mode", self.params.get("topk_mode", "auto")
             )
+            fused = (params or {}).get(
+                "fused_rerank", self.params.get("fused_rerank", True)
+            )
+            if (
+                fused
+                and self._exact_rerank_enabled(params)
+                and not is_disk_store(self.store)
+            ):
+                # default hot path: scan + rerank as ONE device program
+                # (two dispatches paid launch/tunnel latency twice and
+                # round-tripped nothing for it — r4 review next-1);
+                # `fused_rerank: false` keeps the two-step path for A/B
+                base, base_sqnorm, _ = self.store.device_buffer()
+                ivf_ops.note_dispatch("fused_scan_rerank")
+                scores, ids = ivf_ops.int8_scan_rerank(
+                    jnp.asarray(q), approx8, scale, vsq, valid,
+                    base, base_sqnorm, max(r, k), k,
+                    scan_metric=metric, rerank_metric=self.metric,
+                    topk_mode=topk_mode, storage=self.mirror_storage,
+                )
+                scores, ids = jax.device_get((scores, ids))
+                return self._pad_to_k(scores, ids, k)
             scan = (
                 ivf_ops.int8_scan_candidates
                 if self.mirror_storage == "int8"
                 else ivf_ops.int4_scan_candidates
             )
+            ivf_ops.note_dispatch("scan")
             cand_s, cand_i = scan(
                 jnp.asarray(q), approx8, scale, vsq, valid,
                 max(r, k), metric, topk_mode,
@@ -593,6 +616,7 @@ class IVFPQIndex(_IVFBase):
             return self._pad_to_k(scores[:, :k], ids[:, :k], k)
         from vearch_tpu.index._store_paths import rerank_against_store
 
+        ivf_ops.note_dispatch("rerank")
         scores, ids = rerank_against_store(
             self.store, q, cand_i, min(k, int(cand_i.shape[1])), self.metric,
         )

@@ -36,6 +36,24 @@ from vearch_tpu.ops.distance import dot_precision, sqnorms
 
 NEG_INF = float("-inf")
 
+# Optional dispatch ledger: when a list is installed here, index call
+# sites append one tag per device-program launch. Lets tests prove the
+# fused hot path really is ONE program where the unfused path is two
+# (r4 review next-1: each dispatch pays tunnel RTT + scheduling; the
+# CPU-backend trace test demonstrates the reduction when no TPU is
+# reachable).
+_dispatch_ledger: list | None = None
+
+
+def set_dispatch_ledger(ledger: list | None) -> None:
+    global _dispatch_ledger
+    _dispatch_ledger = ledger
+
+
+def note_dispatch(tag: str) -> None:
+    if _dispatch_ledger is not None:
+        _dispatch_ledger.append(tag)
+
 
 def _coarse_probes(
     queries: jax.Array, centroids: jax.Array, nprobe: int
@@ -465,3 +483,40 @@ def exact_rerank(
     k = min(k, scores.shape[1])
     top_s, pos = jax.lax.top_k(scores, k)
     return top_s, jnp.take_along_axis(cand_ids, pos, axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("r", "k", "scan_metric", "rerank_metric",
+                     "topk_mode", "storage"),
+)
+def int8_scan_rerank(
+    queries: jax.Array,      # [B, d] f32
+    approx8: jax.Array,      # [N_pad, d] int8 (or [N_pad, d/2] int4-packed)
+    row_scale: jax.Array,    # [N_pad] f32
+    row_vsq: jax.Array,      # [N_pad] f32
+    valid: jax.Array,        # [N_pad] bool
+    base: jax.Array,         # [capacity, d] raw store buffer
+    base_sqnorm: jax.Array,  # [capacity] f32
+    r: int,
+    k: int,
+    scan_metric: MetricType = MetricType.L2,
+    rerank_metric: MetricType = MetricType.L2,
+    topk_mode: str = "auto",
+    storage: str = "int8",
+) -> tuple[jax.Array, jax.Array]:
+    """Fused compressed scan + exact rerank: ONE device program per
+    search instead of two (r4 review next-1 — each dispatch pays launch
+    scheduling, and over the axon tunnel tens of ms of RTT; fusing also
+    keeps the [B, r] candidate set entirely on device and lets XLA
+    schedule the rerank gather against the scan's top-k tail).
+
+    scan_metric is the compressed-domain metric (cosine scans as IP on
+    pre-normalized rows); rerank_metric the user-facing one. Only the
+    final [B, k] pair ever leaves the device."""
+    scan = (int8_scan_candidates if storage == "int8"
+            else int4_scan_candidates)
+    _, cand_i = scan(queries, approx8, row_scale, row_vsq, valid,
+                     r, scan_metric, topk_mode)
+    return exact_rerank(queries.astype(base.dtype), cand_i, base,
+                        base_sqnorm, k, rerank_metric)
