@@ -1,0 +1,139 @@
+"""Async backup jobs with per-partition progress (r4 review next-5).
+
+Reference surface: async backups with progress endpoints —
+master routes internal/master/cluster_api.go:330-340, PS shard manager
+ps/backup/ps_backup_service.go:77 (jobs), :113 (create), :180
+(progress). Tests poll progress MID-backup of a multi-segment space
+(uploads throttled via monkeypatch) and verify restore still
+verifies-then-swaps afterwards.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import objectstore, rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = StandaloneCluster(data_dir=str(tmp_path / "cluster"), n_ps=2)
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2, "replica_num": 1,
+        "fields": [
+            {"name": "x", "data_type": "integer"},
+            {"name": "v", "data_type": "vector", "dimension": D,
+             "index": {"index_type": "FLAT", "metric_type": "L2",
+                       "params": {}}},
+        ],
+    })
+    rng = np.random.default_rng(3)
+    # two upsert+flush rounds -> multiple segments per partition
+    for r in range(2):
+        cl.upsert("db", "s", [
+            {"_id": f"d{r}_{i}", "x": i,
+             "v": rng.standard_normal(D).tolist()}
+            for i in range(200)
+        ])
+        cl.flush("db", "s")
+    yield c, cl
+    c.stop()
+
+
+def test_async_backup_progress_and_restore(cluster, tmp_path, monkeypatch):
+    c, cl = cluster
+    store_root = str(tmp_path / "bak")
+
+    # throttle uploads so the poll can observe the job mid-flight
+    real_put = objectstore.LocalObjectStore.put_file
+
+    def slow_put(self, key, path):
+        time.sleep(0.05)
+        return real_put(self, key, path)
+
+    monkeypatch.setattr(objectstore.LocalObjectStore, "put_file", slow_put)
+
+    out = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                   {"command": "create", "store_root": store_root,
+                    "async": True})
+    assert out["status"] == "running" and out["version"] >= 1
+    job_id = out["job_id"]
+
+    # a second create while the job runs is refused (space lock held by
+    # the worker)
+    with pytest.raises(rpc.RpcError, match="in progress"):
+        rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                 {"command": "create", "store_root": store_root,
+                  "async": True})
+
+    # poll progress MID-backup: we must see a running snapshot with a
+    # partition actively uploading (files_done strictly between 0 and
+    # total), then completion
+    saw_partial = False
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        job = rpc.call(c.master_addr, "GET", f"/backup/jobs/{job_id}")
+        assert job["db"] == "db" and job["space"] == "s"
+        assert set(job["partitions"].keys()) == {
+            str(p["id"]) for p in cl.get_space("db", "s")["partitions"]}
+        if job["status"] == "running":
+            for p in job["partitions"].values():
+                if (p["status"] == "uploading" and p["files_total"]
+                        and 0 < p["files_done"] < p["files_total"]):
+                    saw_partial = True
+        else:
+            break
+        time.sleep(0.02)
+    assert job["status"] == "done", job
+    assert saw_partial, "never observed mid-flight shard progress"
+    assert len(job["results"]) == 2
+    assert all(p["status"] == "done" for p in job["partitions"].values())
+    assert all(p["files_done"] == p["files_total"]
+               for p in job["partitions"].values())
+
+    # job appears in the list route too
+    jobs = rpc.call(c.master_addr, "GET", "/backup/jobs")["jobs"]
+    assert any(j["job_id"] == job_id for j in jobs)
+
+    # restore still verifies-then-swaps: write extra docs AFTER the
+    # backup, restore the version, and the extras must be gone
+    monkeypatch.setattr(objectstore.LocalObjectStore, "put_file", real_put)
+    cl.upsert("db", "s", [
+        {"_id": f"extra_{i}", "x": i, "v": [0.0] * D} for i in range(50)
+    ])
+    assert len(cl.query("db", "s", filters=None, limit=1000)) == 450
+    rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+             {"command": "restore", "store_root": store_root,
+              "version": out["version"]}, timeout=300.0)
+    assert len(cl.query("db", "s", filters=None, limit=1000)) == 400
+
+
+def test_ps_progress_route_direct(cluster, tmp_path):
+    c, _cl = cluster
+    ps = c.ps_nodes[0]
+    with pytest.raises(rpc.RpcError, match="no backup job"):
+        rpc.call(ps.addr, "GET", "/ps/backup/progress?job_id=nope")
+    # empty list when idle
+    out = rpc.call(ps.addr, "GET", "/ps/backup/progress")
+    assert out == {"jobs": []}
+
+
+def test_sync_backup_unchanged(cluster, tmp_path):
+    """The synchronous path (no `async`) keeps its original contract."""
+    c, _cl = cluster
+    store_root = str(tmp_path / "bak_sync")
+    out = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                   {"command": "create", "store_root": store_root},
+                   timeout=300.0)
+    assert out["version"] >= 1 and len(out["partitions"]) == 2
+    vers = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
+                    {"command": "list", "store_root": store_root})
+    assert out["version"] in vers["versions"]

@@ -34,6 +34,15 @@ from vearch_tpu.utils import log
 
 _log = log.get("master")
 
+
+def _deepcopy_job(job: dict) -> dict:
+    """Stable snapshot of a backup-job record for serving: the worker
+    thread mutates the nested dicts while requests read them."""
+    out = dict(job)
+    out["partitions"] = {k: dict(v) for k, v in job["partitions"].items()}
+    out["results"] = list(job["results"])
+    return out
+
 HEARTBEAT_TTL = 8.0
 
 
@@ -73,6 +82,9 @@ class MasterServer:
         # two concurrent reconfigs could fence at the same term and
         # appoint two leaders, defeating the fencing safety argument
         self._reconfig_lock = threading.Lock()
+        # async backup jobs (reference: backup progress endpoints)
+        self._backup_jobs: dict[str, dict] = {}
+        self._backup_jobs_lock = threading.Lock()
 
         # -- multi-master metadata group (reference: embedded etcd raft,
         # master/server.go:89). peers: {master_node_id: "host:port"}
@@ -154,6 +166,7 @@ class MasterServer:
         s.route("POST", "/config", self._h_set_config)
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)
+        s.route("GET", "/backup/jobs", self._h_backup_jobs)
         s.route("POST", "/alias", self._h_create_alias)
         # PUT modifies (reference: modifyAlias) — same upsert semantics
         s.route("PUT", "/alias", self._h_create_alias)
@@ -1365,6 +1378,19 @@ class MasterServer:
             if not self.store.try_lock(f"backup/{db}/{name}", lock_owner,
                                        ttl_s=600.0):
                 raise RpcError(409, f"backup for {db}/{name} in progress")
+        if command == "create" and body.get("async"):
+            # async create: shard jobs dispatched in parallel, progress
+            # polled into a master job record, caller returns at once
+            # (reference: async backups w/ progress endpoints,
+            # master/cluster_api.go:330-340 + ps_backup_service.go:113).
+            # The worker owns the space lock from here.
+            try:
+                return self._backup_create_async(
+                    db, name, space, body, ostore, servers,
+                    base_prefix, dedup, lock_owner)
+            except BaseException:
+                self.store.unlock(f"backup/{db}/{name}", lock_owner)
+                raise
         try:
             if command == "create":
                 version = self.store.next_id(f"/seq/backup/{db}/{name}")
@@ -1491,6 +1517,147 @@ class MasterServer:
             return {"version": version, "partitions": results}
 
         raise RpcError(400, f"unknown backup command {command!r}")
+
+    def _backup_create_async(self, db, name, space, body, ostore,
+                             servers, base_prefix, dedup,
+                             lock_owner) -> dict:
+        import json as _json
+
+        version = self.store.next_id(f"/seq/backup/{db}/{name}")
+        prefix = f"{base_prefix}/v{version}"
+        ostore.put_bytes(f"{prefix}/space.json",
+                         _json.dumps(space.to_dict()).encode())
+        job_id = f"{db}:{name}:v{version}"
+        job = {
+            "job_id": job_id, "db": db, "space": name, "version": version,
+            "status": "running", "started": time.time(),
+            "updated": time.time(), "error": None,
+            "partitions": {}, "results": [],
+        }
+        shards = []
+        for i, part in enumerate(sorted(space.partitions,
+                                        key=lambda p: p.slot)):
+            srv = servers.get(part.leader)
+            if srv is None:
+                self.store.unlock(f"backup/{db}/{name}", lock_owner)
+                raise RpcError(503, f"leader of partition {part.id} down")
+            shards.append((i, part, srv))
+            job["partitions"][str(part.id)] = {
+                "status": "pending", "files_done": 0, "files_total": None,
+                "node_id": part.leader,
+            }
+        from vearch_tpu.utils import prune_job_registry
+
+        with self._backup_jobs_lock:
+            self._backup_jobs[job_id] = job
+            prune_job_registry(self._backup_jobs)
+        lock_name = f"backup/{db}/{name}"
+        job_timeout = float(body.get("timeout_s", 3600.0))
+
+        def worker():
+            shards_still_running = False
+            try:
+                running = {}
+                for i, part, srv in shards:
+                    sid = f"{job_id}:shard_{i}"
+                    pj = job["partitions"][str(part.id)]
+                    try:
+                        rpc.call(srv.rpc_addr, "POST", "/ps/backup", {
+                            "partition_id": part.id,
+                            "store_root": body.get("store_root"),
+                            "store": body.get("store"),
+                            "key_prefix": f"{prefix}/shard_{i}",
+                            "pool_prefix": (
+                                f"{base_prefix}/pool/shard_{i}"
+                                if dedup else None
+                            ),
+                            "job_id": sid,
+                        })
+                        pj["status"] = "dumping"
+                        running[part.id] = (sid, srv)
+                    except RpcError as e:
+                        pj["status"] = "error"
+                        pj["error"] = e.msg
+                deadline = time.time() + job_timeout
+                while running and time.time() < deadline:
+                    # keep the space lock alive for the job's real
+                    # duration (same-owner try_lock refreshes the TTL):
+                    # a long upload must not let the lock lapse while
+                    # PS shards still mutate the pool's refs.json
+                    self.store.try_lock(lock_name, lock_owner,
+                                        ttl_s=600.0)
+                    for pid_, (sid, srv) in list(running.items()):
+                        pj = job["partitions"][str(pid_)]
+                        try:
+                            st = rpc.call(
+                                srv.rpc_addr, "GET",
+                                f"/ps/backup/progress?job_id={sid}")
+                        except RpcError:
+                            continue  # transient; keep polling
+                        pj.update(
+                            status=st["status"],
+                            files_done=st.get("files_done", 0),
+                            files_total=st.get("files_total"),
+                        )
+                        if st["status"] == "done":
+                            job["results"].append(st.get("result"))
+                            del running[pid_]
+                        elif st["status"] == "error":
+                            pj["error"] = st.get("error")
+                            del running[pid_]
+                        job["updated"] = time.time()
+                    # CLI refreshes at 0.5s; polling much faster only
+                    # burns RPCs (review r5)
+                    time.sleep(0.25)
+                errs = [p for p in job["partitions"].values()
+                        if p["status"] == "error"]
+                if running:
+                    shards_still_running = True
+                    job["status"] = "error"
+                    job["error"] = "timed out waiting for shards " + str(
+                        sorted(running))
+                elif errs:
+                    job["status"] = "error"
+                    job["error"] = "; ".join(
+                        str(p.get("error")) for p in errs)
+                else:
+                    job["status"] = "done"
+                job["updated"] = time.time()
+            except Exception as e:  # job record must never stick "running"
+                job.update(status="error",
+                           error=f"{type(e).__name__}: {e}",
+                           updated=time.time())
+            finally:
+                if not shards_still_running:
+                    self.store.unlock(lock_name, lock_owner)
+                # else: PS shards may still be mutating the pool's
+                # refs.json — leave the lock to its TTL rather than
+                # open a concurrent-create window (the timeout error
+                # already tells the operator what happened)
+
+        threading.Thread(target=worker, daemon=True,
+                         name=f"backup-{job_id}").start()
+        return {"version": version, "job_id": job_id, "status": "running"}
+
+    def _h_backup_jobs(self, body, parts) -> dict:
+        """Master backup-job progress (reference: backup progress routes,
+        master/cluster_api.go:330-340). GET /backup/jobs lists; GET
+        /backup/jobs/{job_id} details one (job ids contain ':', so they
+        arrive as a single path part). Job records live on the leader
+        (the worker runs there), so followers forward like the other
+        leader-state GETs."""
+        fwd = self._leader_get(
+            "/backup/jobs" + (f"/{parts[0]}" if parts else ""))
+        if fwd is not None:
+            return fwd
+        with self._backup_jobs_lock:
+            if parts:
+                job = self._backup_jobs.get(parts[0])
+                if job is None:
+                    raise RpcError(404, f"no backup job {parts[0]}")
+                return _deepcopy_job(job)
+            return {"jobs": [_deepcopy_job(j)
+                             for j in self._backup_jobs.values()]}
 
     # -- space create (reference: services/space_service.go:59) --------------
 

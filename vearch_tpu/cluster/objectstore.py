@@ -83,7 +83,7 @@ class ObjectStore:
     #    backup versions) ---------------------------------------------------
 
     def put_tree_dedup(self, version_prefix: str, local_dir: str,
-                       pool_prefix: str) -> dict:
+                       pool_prefix: str, progress=None) -> dict:
         """Upload a tree content-addressed: file payloads land in
         `{pool_prefix}/blobs/{sha256}` (skipped when already present —
         unchanged segments cost nothing across versions), the version
@@ -125,14 +125,17 @@ class ObjectStore:
                        json.dumps(manifest).encode())
         new = 0
         done: set[str] = set()
-        for h, full in uploads:
-            if h in done:
-                continue
-            done.add(h)
-            blob_key = f"{pool_prefix}/blobs/{h}"
-            if not self.exists(blob_key):
-                self.put_file(blob_key, full)
-                new += 1
+        for pos, (h, full) in enumerate(uploads):
+            if h not in done:
+                done.add(h)
+                blob_key = f"{pool_prefix}/blobs/{h}"
+                if not self.exists(blob_key):
+                    self.put_file(blob_key, full)
+                    new += 1
+            if progress is not None:
+                # progress(files_done, files_total) after each file —
+                # the async backup job's per-partition counter
+                progress(pos + 1, len(uploads))
         return {"files": len(manifest), "blobs_uploaded": new,
                 "blobs_shared": len(seen) - new}
 
@@ -215,7 +218,8 @@ class ObjectStore:
     # -- tree transfer with CRC32 manifest (reference: ps/backup crc
     #    integrity + ref-counted shard files) ------------------------------
 
-    def put_tree(self, key_prefix: str, local_dir: str) -> int:
+    def put_tree(self, key_prefix: str, local_dir: str,
+                 progress=None) -> int:
         """Upload a directory tree. The manifest (per-file CRC32 + size,
         streamed, never whole-file in memory) is written FIRST: a backup
         interrupted mid-upload then fails restore loudly as incomplete,
@@ -231,8 +235,10 @@ class ObjectStore:
                 paths.append((rel, full))
         self.put_bytes(f"{key_prefix}/{MANIFEST}",
                        json.dumps(manifest).encode())
-        for rel, full in paths:
+        for pos, (rel, full) in enumerate(paths):
             self.put_file(f"{key_prefix}/{rel}", full)
+            if progress is not None:
+                progress(pos + 1, len(paths))
         return len(paths)
 
     def get_tree(self, key_prefix: str, local_dir: str) -> int:

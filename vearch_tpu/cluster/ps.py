@@ -109,6 +109,9 @@ class PSServer:
         # request killer). 0 disables the automatic killer.
         self._inflight: dict[str, dict] = {}
         self._inflight_lock = threading.Lock()
+        # async shard-backup jobs (reference: PSShardManager state)
+        self._backup_jobs: dict[str, dict] = {}
+        self._backup_jobs_lock = threading.Lock()
         self.slow_request_ms = 0
         self.killed_requests = 0
         # slow-query isolation (reference: dedicated slow-search channel
@@ -148,6 +151,7 @@ class PSServer:
         s.route("POST", "/ps/flush", self._h_flush)
         s.route("POST", "/ps/engine/config", self._h_engine_config)
         s.route("POST", "/ps/backup", self._h_backup)
+        s.route("GET", "/ps/backup/progress", self._h_backup_progress)
         s.route("POST", "/ps/restore", self._h_restore)
         s.route("GET", "/ps/stats", self._h_stats)
         s.route("POST", "/ps/kill", self._h_kill)
@@ -1038,22 +1042,78 @@ class PSServer:
         return make_object_store(spec)
 
     def _h_backup(self, body: dict, _parts) -> dict:
+        pid = int(body["partition_id"])
+        self._engine(pid)  # partition must exist before we accept a job
+        store = self._backup_store(body)
+        job_id = body.get("job_id")
+        if job_id is None:
+            # synchronous shard backup (original path; the master's
+            # async create passes a job_id instead)
+            return self._run_shard_backup(pid, store, body, None)
+        # async shard backup with progress (reference: PSShardManager
+        # jobs, ps/backup/ps_backup_service.go:77,113 — the shard
+        # manager tracks per-shard state the progress route reports)
+        job = {"job_id": job_id, "partition_id": pid, "status": "dumping",
+               "files_done": 0, "files_total": None, "started": time.time(),
+               "updated": time.time(), "result": None, "error": None}
+        from vearch_tpu.utils import prune_job_registry
+
+        with self._backup_jobs_lock:
+            jobs = self._backup_jobs
+            if job_id in jobs and jobs[job_id]["status"] in (
+                    "dumping", "uploading"):
+                raise RpcError(409, f"backup job {job_id} already running")
+            jobs[job_id] = job
+            prune_job_registry(jobs)
+
+        def run():
+            try:
+                out = self._run_shard_backup(pid, store, body, job)
+                job.update(status="done", result=out, updated=time.time())
+            except Exception as e:
+                job.update(status="error", error=f"{type(e).__name__}: {e}",
+                           updated=time.time())
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"backup-{job_id}").start()
+        return {"partition_id": pid, "job_id": job_id, "status": "dumping"}
+
+    def _run_shard_backup(self, pid: int, store, body: dict,
+                          job: dict | None) -> dict:
         import tempfile
 
-        pid = int(body["partition_id"])
         eng = self._engine(pid)
-        store = self._backup_store(body)
+
+        def progress(done_files: int, total: int) -> None:
+            if job is not None:
+                job.update(status="uploading", files_done=done_files,
+                           files_total=total, updated=time.time())
+
         with tempfile.TemporaryDirectory() as tmp:
             eng.dump(tmp)
             if body.get("pool_prefix"):
                 # content-addressed dedup across versions (reference:
                 # ref_count_manager.go ref-counted shard files)
                 out = store.put_tree_dedup(
-                    body["key_prefix"], tmp, body["pool_prefix"]
+                    body["key_prefix"], tmp, body["pool_prefix"],
+                    progress=progress,
                 )
                 return {"partition_id": pid, **out}
-            n = store.put_tree(body["key_prefix"], tmp)
+            n = store.put_tree(body["key_prefix"], tmp, progress=progress)
         return {"partition_id": pid, "files": n}
+
+    def _h_backup_progress(self, body: dict, _parts) -> dict:
+        """Per-shard job state (reference: PS backup progress route,
+        ps_backup_service.go:180)."""
+        job_id = ((body or {}).get("_query") or {}).get("job_id") \
+            or (body or {}).get("job_id")
+        with self._backup_jobs_lock:
+            if job_id:
+                job = self._backup_jobs.get(str(job_id))
+                if job is None:
+                    raise RpcError(404, f"no backup job {job_id}")
+                return dict(job)
+            return {"jobs": [dict(j) for j in self._backup_jobs.values()]}
 
     def _h_restore(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])

@@ -58,6 +58,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--s3-secret-key", default=None)
     ap.add_argument("--s3-region", default=None)
     ap.add_argument("--s3-prefix", default=None)
+    ap.add_argument("--sync", action="store_true",
+                    help="create: block until the backup finishes "
+                         "instead of running it as an async job")
     args = ap.parse_args(argv)
 
     from vearch_tpu.cluster import rpc
@@ -67,12 +70,46 @@ def main(argv: list[str] | None = None) -> int:
         if args.version is None:
             raise SystemExit(f"{args.command} needs --version")
         body["version"] = args.version
+    if args.command == "create" and not args.sync:
+        body["async"] = True
     auth = (args.user, args.password) if args.user else None
     try:
         out = rpc.call(
             args.master, "POST",
             f"/backup/dbs/{args.db}/spaces/{args.space}", body, auth=auth,
         )
+        if args.command == "create" and not args.sync:
+            # poll the master job to completion, showing per-partition
+            # progress (reference: async backup + progress endpoints)
+            job_id = out["job_id"]
+            import time as _time
+
+            poll_deadline = _time.time() + 3600.0
+            while True:
+                if _time.time() > poll_deadline:
+                    print("\ngave up polling after 1h; job may still be "
+                          f"running: GET /backup/jobs/{job_id}",
+                          file=sys.stderr)
+                    return 1
+                job = rpc.call(args.master, "GET",
+                               f"/backup/jobs/{job_id}", auth=auth)
+                parts = job["partitions"]
+                line = " ".join(
+                    f"p{pid}:{p['status']}"
+                    + (f"({p['files_done']}/{p['files_total']})"
+                       if p.get("files_total") else "")
+                    for pid, p in sorted(parts.items())
+                )
+                print(f"\r{job['status']}: {line}", end="",
+                      file=sys.stderr, flush=True)
+                if job["status"] != "running":
+                    print(file=sys.stderr)
+                    break
+                _time.sleep(0.5)
+            out = job
+            if job["status"] == "error":
+                print(json.dumps(out, indent=2))
+                return 1
     except rpc.RpcError as e:
         print(f"error ({e.code}): {e.msg}", file=sys.stderr)
         return 1

@@ -21,3 +21,13 @@ def apply_jax_platform_env() -> None:
         # backend already initialised: the config is frozen, which also
         # means plugin discovery already happened — nothing to prevent
         pass
+
+
+def prune_job_registry(jobs: dict, keep: int = 64) -> None:
+    """Age out completed job records oldest-first, keeping `keep`
+    finished entries (shared by the master and PS async-backup
+    registries; caller holds the registry lock)."""
+    done = [k for k in sorted(jobs, key=lambda k: jobs[k]["updated"])
+            if jobs[k]["status"] in ("done", "error")]
+    for old in done[:-keep]:
+        del jobs[old]
