@@ -371,3 +371,208 @@ def test_follower_ops_views_forward_to_leader(tmp_path, rng):
                 m.stop()
             except Exception:
                 pass
+
+
+def test_dynamic_member_add_snapshot_catchup_and_leader_kill(tmp_path):
+    """r4 review next-6: a 4th master joins a LIVE 3-master group,
+    catches up via snapshot install (the meta log is truncated behind
+    checkpoints), survives a leader kill, and a member remove keeps the
+    group writable without quorum loss. Design: single-server config
+    changes through the replicated log (raft §4.2.2), one at a time."""
+    masters = make_masters(tmp_path, meta_log_keep=8, meta_flush_every=10)
+    try:
+        wait_leader(masters)
+        # enough writes that the joiner lands behind the truncation
+        # horizon and must take a snapshot
+        for i in range(40):
+            call_retry(multi_addr(masters), "POST", f"/dbs/d{i:02d}")
+
+        joiner = MasterServer(
+            persist_path=str(tmp_path / "m4" / "meta.json"),
+            meta_dir=str(tmp_path / "m4"),
+            node_id=4, peers={4: ""},
+            election_timeout=1.0, heartbeat_ttl=2.0,
+            join=multi_addr(masters),
+        )
+        joiner.start()
+        masters.append(joiner)
+
+        # membership converges to 4 on every node
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sizes = {len(m.peers) for m in masters}
+            if sizes == {4}:
+                break
+            time.sleep(0.1)
+        assert {len(m.peers) for m in masters} == {4}
+        out = rpc.call(joiner.addr, "GET", "/members")
+        assert {m["node_id"] for m in out["members"]} == {1, 2, 3, 4}
+
+        # the joiner replays/installs until it serves the full dataset
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            dbs = {d["name"] for d in
+                   rpc.call(joiner.addr, "GET", "/dbs")["dbs"]}
+            if {f"d{i:02d}" for i in range(40)} <= dbs:
+                break
+            time.sleep(0.2)
+        assert {f"d{i:02d}" for i in range(40)} <= {
+            d["name"] for d in rpc.call(joiner.addr, "GET", "/dbs")["dbs"]}
+        # catch-up crossed the truncation horizon -> snapshot install
+        assert joiner.meta_node.snapshots_installed >= 1
+
+        # leader kill: the remaining 3-of-4 (joiner included) elect and
+        # stay writable
+        leader = wait_leader(masters)
+        leader.stop()
+        alive = [m for m in masters if m is not leader]
+        wait_leader(alive)
+        call_retry(multi_addr(alive), "POST", "/dbs/after_kill")
+        for m in alive:
+            names = {d["name"] for d in
+                     rpc.call(m.addr, "GET", "/dbs")["dbs"]}
+            assert "after_kill" in names, m.node_id
+
+        # remove the dead member: group shrinks to 3, quorum 2, still
+        # writable; every live node sees the new membership
+        call_retry(multi_addr(alive), "POST", "/members/remove",
+                   {"node_id": leader.node_id})
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if all(leader.node_id not in m.peers for m in alive):
+                break
+            time.sleep(0.1)
+        for m in alive:
+            assert leader.node_id not in m.peers, m.node_id
+            assert len(m.peers) == 3
+        call_retry(multi_addr(alive), "POST", "/dbs/after_remove")
+        for m in alive:
+            names = {d["name"] for d in
+                     rpc.call(m.addr, "GET", "/dbs")["dbs"]}
+            assert "after_remove" in names, m.node_id
+        masters.remove(leader)
+    finally:
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+
+def test_member_remove_follower_keeps_quorum(tmp_path):
+    """Removing a live follower from a 3-group leaves a writable
+    2-member group (quorum 2) and the removed node stops leading."""
+    masters = make_masters(tmp_path)
+    try:
+        leader = wait_leader(masters)
+        victim = next(m for m in masters if m is not leader)
+        call_retry(multi_addr(masters), "POST", "/members/remove",
+                   {"node_id": victim.node_id})
+        deadline = time.time() + 20
+        rest = [m for m in masters if m is not victim]
+        while time.time() < deadline:
+            if all(victim.node_id not in m.peers for m in rest):
+                break
+            time.sleep(0.1)
+        for m in rest:
+            assert victim.node_id not in m.peers
+        call_retry(multi_addr(rest), "POST", "/dbs/two_member_write")
+        for m in rest:
+            names = {d["name"] for d in
+                     rpc.call(m.addr, "GET", "/dbs")["dbs"]}
+            assert "two_member_write" in names
+        # the pruned node must not keep campaigning: past several
+        # election timeouts the group holds a stable leader and the
+        # victim never becomes one (review r5 — term-inflation
+        # disruption from removed members)
+        time.sleep(3.5)
+        assert not victim.is_leader
+        wait_leader(rest)
+        term_a = max(m.meta_node.term for m in rest)
+        time.sleep(2.5)
+        term_b = max(m.meta_node.term for m in rest)
+        assert term_b <= term_a + 1, (term_a, term_b)
+        call_retry(multi_addr(rest), "POST", "/dbs/still_writable")
+        # one change at a time: errors surface cleanly
+        with pytest.raises(rpc.RpcError, match="no member"):
+            rpc.call(multi_addr(rest), "POST", "/members/remove",
+                     {"node_id": 99})
+    finally:
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+
+def test_joiner_log_replay_persists_full_membership(tmp_path):
+    """A joiner that catches up via LOG REPLAY (no snapshot) applies its
+    own add entry while its local peers map is still just itself; the
+    persisted membership must come from the op's full member map, not
+    local state — or a restart becomes a quorum-of-1 split brain
+    (review r5)."""
+    masters = make_masters(tmp_path)  # default keep: log replay path
+    joiner = None
+    try:
+        wait_leader(masters)
+        call_retry(multi_addr(masters), "POST", "/dbs/pre_join")
+
+        jdir = tmp_path / "m4"
+        joiner = MasterServer(
+            persist_path=str(jdir / "meta.json"),
+            meta_dir=str(jdir),
+            node_id=4, peers={4: ""},
+            election_timeout=1.0, heartbeat_ttl=2.0,
+            join=multi_addr(masters),
+        )
+        joiner.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (joiner.store.get("/dbs/pre_join") is not None
+                    or {d["name"] for d in rpc.call(
+                        joiner.addr, "GET", "/dbs")["dbs"]}):
+                break
+            time.sleep(0.2)
+        assert joiner.meta_node.snapshots_installed == 0, \
+            "test wants the log-replay path"
+        # the PERSISTED membership on the joiner covers the whole group
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            saved = joiner.store.get("/meta/members") or {}
+            if set(saved) == {"1", "2", "3", "4"}:
+                break
+            time.sleep(0.1)
+        assert set(joiner.store.get("/meta/members")) == {"1", "2", "3",
+                                                          "4"}
+        # restart the joiner on its dirs: it must come back as a
+        # 4-member follower, not a self-electing singleton
+        jaddr_peers = dict(joiner.peers)
+        joiner.stop()
+        joiner = MasterServer(
+            persist_path=str(jdir / "meta.json"),
+            meta_dir=str(jdir),
+            node_id=4, peers={4: ""},
+            election_timeout=1.0, heartbeat_ttl=2.0,
+            join=multi_addr(masters),
+        )
+        assert len(joiner.peers) == 4, joiner.peers
+        joiner.start()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if len(joiner.peers) == 4 and not (
+                    joiner.is_leader and len(joiner.meta_node.members) < 4):
+                break
+            time.sleep(0.2)
+        assert sorted(joiner.meta_node.members) == [1, 2, 3, 4]
+        del jaddr_peers
+    finally:
+        if joiner is not None:
+            try:
+                joiner.stop()
+            except Exception:
+                pass
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass

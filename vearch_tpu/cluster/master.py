@@ -63,6 +63,7 @@ class MasterServer:
         election_timeout: float = 1.0,
         meta_log_keep: int = 1000,
         meta_flush_every: int = 500,
+        join: str | None = None,
     ):
         from vearch_tpu.cluster.auth import AuthService, parse_basic_auth
 
@@ -94,10 +95,25 @@ class MasterServer:
         # their replicated store.
         self.node_id = node_id
         self.peers = dict(peers) if peers else {node_id: ""}
-        self.replicated = len(self.peers) > 1
+        # `join`: address of any live master of an existing replicated
+        # group — this node registers itself via POST /members/add at
+        # start() and catches up by log replay or snapshot (reference:
+        # etcd member add, cluster_api.go:344-354)
+        self.join_addr = join
+        self.replicated = len(self.peers) > 1 or join is not None
+        self._members_lock = threading.RLock()  # guards the peers map
+        # held across a member-change propose: one add/remove at a time
+        # (NOT the same lock as _members_lock — the apply path takes
+        # that, and apply may run on another thread mid-propose)
+        self._member_change_gate = threading.Lock()
         self.meta_node = None
         self._was_leader = not self.replicated
         self.election_timeout = election_timeout
+        # a restarted member's peers may have changed since its --peers
+        # flag: the replicated membership key is authoritative
+        saved = self.store.get("/meta/members")
+        if self.replicated and saved:
+            self.peers = {int(k): v for k, v in saved.items()}
         if self.replicated:
             assert meta_dir, "multi-master mode needs meta_dir for the WAL"
             # the WAL gets truncated behind checkpoints; without a
@@ -147,6 +163,8 @@ class MasterServer:
         s.route("GET", "/cluster/stats", self._h_cluster_stats)
         s.route("GET", "/cluster/health", self._h_cluster_health)
         s.route("GET", "/members", self._h_members)
+        s.route("POST", "/members/add", self._h_member_add)
+        s.route("POST", "/members/remove", self._h_member_remove)
         s.route("GET", "/schedule/fail_server", self._h_fail_servers)
         s.route("DELETE", "/schedule/fail_server",
                 self._h_fail_server_clear)
@@ -324,6 +342,8 @@ class MasterServer:
             # state, so recovery replays exactly the unapplied tail
             # (next_id is not idempotent — double-replay would skew ids)
             store.applied_index = self.meta_node.applied + 1
+            if (op.get("t") or op.get("type")) == "member_change":
+                return self._apply_member_change(op)
             return store.apply_op(op)
 
         def send(peer: int, path: str, body: dict) -> dict:
@@ -346,7 +366,7 @@ class MasterServer:
             members=sorted(self.peers),
             is_leader=False,
             snapshot_fn=snapshot,
-            install_fn=lambda data, idx: store.install_snapshot(data),
+            install_fn=lambda data, idx: self._install_meta_snapshot(data),
             quorum_timeout=5.0,
             election_timeout=self.election_timeout,
             route_prefix="/master/raft",
@@ -515,6 +535,18 @@ class MasterServer:
         if self.auto_recover:
             threading.Thread(target=self._auto_recover_loop,
                              daemon=True).start()
+        if self.join_addr and len(self.peers) <= 1:
+            # register with the existing group (any member forwards the
+            # POST to the leader); the response carries the full member
+            # map, and the leader starts replicating to us — catch-up
+            # is ordinary log replay or a snapshot install
+            out = rpc.call(self.join_addr, "POST", "/members/add",
+                           {"node_id": self.node_id, "addr": self.addr},
+                           timeout=30.0)
+            with self._members_lock:
+                self.peers = {int(k): v for k, v in out["members"].items()}
+                with self.meta_node._lock:
+                    self.meta_node.members = sorted(self.peers)
         if self.replicated:
             threading.Thread(target=self._election_loop,
                              daemon=True).start()
@@ -921,10 +953,9 @@ class MasterServer:
         return {"status": worst if spaces else "green", "spaces": spaces}
 
     def _h_members(self, _body, _parts) -> dict:
-        """Metadata-raft membership (reference: GET /members). Static in
-        this design — members come from --peers; add/remove would need a
-        joint-consensus step the reference gets from etcd (declined in
-        docs/PARITY.md)."""
+        """Metadata-raft membership (reference: GET /members +
+        memberAdd/memberDelete, cluster_api.go:344-354). Dynamic:
+        POST /members/add and /members/remove change it at runtime."""
         if self.replicated:
             leader_id = (self.node_id if self.is_leader
                          else self.meta_node.leader_hint)
@@ -934,6 +965,107 @@ class MasterServer:
             {"node_id": nid, "addr": addr, "leader": nid == leader_id}
             for nid, addr in sorted(self.peers.items())
         ]}
+
+    # -- dynamic metadata-raft membership ------------------------------------
+    #
+    # Design choice (documented per r4 review next-6): SINGLE-SERVER
+    # configuration changes through the replicated log (raft §4.2.2) —
+    # one add/remove at a time, gated by _members_lock held across the
+    # propose, applied at commit on every member. One-at-a-time keeps
+    # old and new quorums overlapping without joint consensus; the
+    # change entry itself commits under the OLD membership. A joiner
+    # starts empty and catches up by log replay or snapshot install
+    # (the snapshot carries /meta/members, reloaded on install).
+
+    def _apply_member_change(self, op: dict):
+        action = op["action"]
+        nid = int(op["node_id"])
+        # the op carries the FULL resulting member map, computed by the
+        # proposing leader (which has the complete picture). Deriving it
+        # from local self.peers here would be wrong on a joiner applying
+        # its own add mid-catch-up (its peers map is just itself), and
+        # that incomplete map would persist as authoritative — on
+        # restart a quorum-of-1 split brain (review r5).
+        new_peers = {int(k): str(v) for k, v in op["members"].items()}
+        node = self.meta_node
+        with self._members_lock:
+            self.peers = new_peers
+            with node._lock:
+                node.members = sorted(new_peers)
+                node._match = {p: v for p, v in node._match.items()
+                               if p in node.members}
+                if node.is_leader:
+                    for p in node.members:
+                        if p != self.node_id:
+                            node._next.setdefault(
+                                p, node.wal.last_index + 1)
+                if action == "remove" and nid == self.node_id:
+                    # removed self: stop leading/campaigning; the node
+                    # stays up for reads until the operator retires it
+                    node.is_leader = False
+            # deterministic store write so restarts and snapshots carry
+            # the membership (apply runs on every replica)
+            self.store._do_put(
+                "/meta/members",
+                {str(i): a for i, a in sorted(new_peers.items())})
+        return {"members": {str(i): a for i, a in sorted(new_peers.items())}}
+
+    def _install_meta_snapshot(self, data: bytes) -> None:
+        self.store.install_snapshot(data)
+        saved = self.store.get("/meta/members")
+        if saved:
+            with self._members_lock:
+                self.peers = {int(k): v for k, v in saved.items()}
+                if self.meta_node is not None:
+                    with self.meta_node._lock:
+                        self.meta_node.members = sorted(self.peers)
+
+    def _h_member_add(self, body: dict, _parts) -> dict:
+        if not self.replicated:
+            raise RpcError(400, "single-master mode has no member group")
+        nid = int(body["node_id"])
+        addr = str(body["addr"])
+        with self._member_change_gate:
+            with self._members_lock:
+                cur = self.peers.get(nid)
+                if cur is not None and cur != addr:
+                    raise RpcError(
+                        409, f"member {nid} exists at {cur!r}; remove it "
+                             f"before re-adding at a new address")
+                already = cur == addr
+            if not already:
+                with self._members_lock:
+                    resulting = {str(i): a
+                                 for i, a in sorted(self.peers.items())}
+                    resulting[str(nid)] = addr
+                self.meta_node.propose([{
+                    "type": "member_change", "action": "add",
+                    "node_id": nid, "addr": addr, "members": resulting,
+                }])
+        return {"members": {str(i): a
+                            for i, a in sorted(self.peers.items())},
+                "leader": self.node_id}
+
+    def _h_member_remove(self, body: dict, _parts) -> dict:
+        if not self.replicated:
+            raise RpcError(400, "single-master mode has no member group")
+        nid = int(body["node_id"])
+        with self._member_change_gate:
+            with self._members_lock:
+                if nid not in self.peers:
+                    raise RpcError(404, f"no member {nid}")
+                if len(self.peers) <= 1:
+                    raise RpcError(400, "cannot remove the last member")
+            with self._members_lock:
+                resulting = {str(i): a
+                             for i, a in sorted(self.peers.items())
+                             if i != nid}
+            self.meta_node.propose([{
+                "type": "member_change", "action": "remove",
+                "node_id": nid, "members": resulting,
+            }])
+        return {"members": {str(i): a
+                            for i, a in sorted(self.peers.items())}}
 
     def _h_fail_servers(self, _body, _parts) -> dict:
         return {"fail_servers": [
@@ -1545,6 +1677,10 @@ class MasterServer:
             job["partitions"][str(part.id)] = {
                 "status": "pending", "files_done": 0, "files_total": None,
                 "node_id": part.leader,
+                # pre-seeded so later updates never RESIZE the dict — a
+                # concurrent _deepcopy_job iterates it without the GIL
+                # saving us from 'changed size during iteration'
+                "error": None,
             }
         from vearch_tpu.utils import prune_job_registry
 

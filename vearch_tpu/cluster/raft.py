@@ -462,6 +462,12 @@ class RaftNode:
         with self._lock:
             if self.is_leader or self._stopped:
                 return
+            if self.node_id not in self.members:
+                # removed from the group (dynamic membership): a pruned
+                # node no longer receives heartbeats, so without this
+                # guard its timer would fire forever, deposing the real
+                # leader by term inflation every timeout
+                return
             quiet = time.time() - self._last_leader_contact
             if quiet < self.election_timeout * self._election_jitter:
                 return
@@ -519,6 +525,10 @@ class RaftNode:
         """RequestVote (raft §5.2 + §5.4.1 up-to-date restriction)."""
         with self._lock:
             term = int(body["term"])
+            if int(body["candidate"]) not in self.members:
+                # a node removed from the group must not win (or even
+                # disrupt) elections of the group it was removed from
+                return {"granted": False, "term": self.term}
             if term < self.term:
                 return {"granted": False, "term": self.term}
             if term > self.term:

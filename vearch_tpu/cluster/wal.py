@@ -136,12 +136,19 @@ class Wal:
             return None
 
     def term_at(self, index: int) -> int | None:
-        """Term of the entry at `index`; 0 for the sentinel before the
-        log; the persisted horizon term at first_index - 1; None when
-        the entry has been truncated away (and the horizon term is
-        unknown) or is beyond the end."""
-        if index == 0:
-            return 0
+        """Term of the entry at `index`; the persisted horizon term at
+        first_index - 1 (which is the 0-sentinel, term 0, for a
+        never-compacted log); None when the entry has been truncated
+        away (and the horizon term is unknown) or is beyond the end.
+
+        NOTE: index 0 deliberately has NO special case. On a compacted
+        log (first_index > 1) an unconditional `term_at(0) == 0` let a
+        leader believe it could serve an append anchored at prev=0 —
+        but entries 1..first_index-1 are GONE, so the 'entries from 1'
+        it would attach actually start at first_index and the follower
+        hits an append gap. Returning None forces the snapshot path for
+        followers behind the horizon (found by the empty-log master
+        joiner)."""
         e = self.get(index)
         if e is not None:
             return int(e["term"])
