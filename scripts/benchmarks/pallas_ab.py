@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""A/B microbench: XLA full-scan paths vs the fused block-max Pallas
+kernel (r4 review next-7's hardware hook). For each batch size it times
+
+  xla_two_step   — int8_scan_candidates + exact_rerank (2 dispatches)
+  xla_fused      — int8_scan_rerank (1 dispatch, default hot path)
+  pallas_blockmax — int8_blockmax_scan_pallas + exact_rerank
+
+and prints one JSON line per (variant, batch). On CPU the Pallas kernel
+runs in interpret mode and is NOT meaningful — run this on TPU.
+
+Run: python scripts/benchmarks/pallas_ab.py [--n 1000000] [--d 128]
+       [--batches 1,32,1024] [--r 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from vearch_tpu.utils import apply_jax_platform_env  # noqa: E402
+
+apply_jax_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from vearch_tpu.engine.types import MetricType  # noqa: E402
+from vearch_tpu.ops import ivf as ivf_ops  # noqa: E402
+from vearch_tpu.ops.pallas_kernels import (  # noqa: E402
+    int8_blockmax_scan_pallas,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--d", type=int, default=128)
+    ap.add_argument("--batches", default="1,32,1024")
+    ap.add_argument("--r", type=int, default=128)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    n_pad = -(-args.n // 512) * 512
+    base = rng.standard_normal((n_pad, args.d)).astype(np.float32)
+    scale = np.maximum(np.abs(base).max(axis=1) / 127.0, 1e-12)
+    q8 = np.clip(np.rint(base / scale[:, None]), -127, 127).astype(np.int8)
+    deq = q8.astype(np.float32) * scale[:, None]
+    vsq = np.sum(deq * deq, axis=1).astype(np.float32)
+    valid = np.ones(n_pad, dtype=bool)
+    valid[args.n:] = False
+
+    d_q8 = jnp.asarray(q8)
+    d_scale = jnp.asarray(scale.astype(np.float32))
+    d_vsq = jnp.asarray(vsq)
+    d_valid = jnp.asarray(valid)
+    d_base = jnp.asarray(base, jnp.bfloat16)
+    d_bsq = jnp.asarray(vsq)  # rerank against the dequant mirror
+
+    def timeit(fn):
+        jax.block_until_ready(fn())  # compile
+        iters, t_end = 0, time.time() + args.seconds
+        t0 = time.time()
+        while time.time() < t_end:
+            jax.block_until_ready(fn())
+            iters += 1
+        return (time.time() - t0) / max(iters, 1)
+
+    for b in [int(x) for x in args.batches.split(",")]:
+        q = jnp.asarray(rng.standard_normal((b, args.d)), jnp.float32)
+
+        def xla_two_step():
+            cs, ci = ivf_ops.int8_scan_candidates(
+                q, d_q8, d_scale, d_vsq, d_valid, args.r,
+                MetricType.L2, "auto")
+            return ivf_ops.exact_rerank(
+                q.astype(d_base.dtype), ci, d_base, d_bsq, args.k,
+                MetricType.L2)
+
+        def xla_fused():
+            return ivf_ops.int8_scan_rerank(
+                q, d_q8, d_scale, d_vsq, d_valid, d_base, d_bsq,
+                args.r, args.k, MetricType.L2, MetricType.L2, "auto",
+                "int8")
+
+        def pallas_blockmax():
+            cs, ci = int8_blockmax_scan_pallas(
+                q, d_q8, d_scale, d_vsq, d_valid, args.r, True)
+            return ivf_ops.exact_rerank(
+                q.astype(d_base.dtype), ci, d_base, d_bsq, args.k,
+                MetricType.L2)
+
+        for name, fn in (("xla_two_step", xla_two_step),
+                         ("xla_fused", xla_fused),
+                         ("pallas_blockmax", pallas_blockmax)):
+            dt = timeit(fn)
+            print(json.dumps({
+                "variant": name, "backend": jax.default_backend(),
+                "n": args.n, "d": args.d, "batch": b, "r": args.r,
+                "ms": round(dt * 1e3, 3), "qps": round(b / dt, 1),
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -526,10 +526,28 @@ class IVFPQIndex(_IVFBase):
             topk_mode = (params or {}).get(
                 "topk_mode", self.params.get("topk_mode", "auto")
             )
+            scan_kernel = (params or {}).get(
+                "scan_kernel", self.params.get("scan_kernel", "xla")
+            )
             fused = (params or {}).get(
                 "fused_rerank", self.params.get("fused_rerank", True)
             )
-            if (
+            if scan_kernel == "pallas" and self.mirror_storage == "int8":
+                # one-pass fused block-max kernel: scores stay in VMEM,
+                # only [B, N/512] block maxima reach HBM (vs the XLA
+                # path's [B, N] f32 score matrix). Behind a flag for
+                # hardware A/B (r4 review next-7; microbench hook:
+                # scripts/benchmarks/pallas_ab.py).
+                from vearch_tpu.ops.pallas_kernels import (
+                    int8_blockmax_scan_pallas,
+                )
+
+                ivf_ops.note_dispatch("pallas_blockmax_scan")
+                cand_s, cand_i = int8_blockmax_scan_pallas(
+                    jnp.asarray(q), approx8, scale, vsq, valid,
+                    max(r, k), metric is MetricType.L2,
+                )
+            elif (
                 fused
                 and self._exact_rerank_enabled(params)
                 and not is_disk_store(self.store)
@@ -548,16 +566,17 @@ class IVFPQIndex(_IVFBase):
                 )
                 scores, ids = jax.device_get((scores, ids))
                 return self._pad_to_k(scores, ids, k)
-            scan = (
-                ivf_ops.int8_scan_candidates
-                if self.mirror_storage == "int8"
-                else ivf_ops.int4_scan_candidates
-            )
-            ivf_ops.note_dispatch("scan")
-            cand_s, cand_i = scan(
-                jnp.asarray(q), approx8, scale, vsq, valid,
-                max(r, k), metric, topk_mode,
-            )
+            else:
+                scan = (
+                    ivf_ops.int8_scan_candidates
+                    if self.mirror_storage == "int8"
+                    else ivf_ops.int4_scan_candidates
+                )
+                ivf_ops.note_dispatch("scan")
+                cand_s, cand_i = scan(
+                    jnp.asarray(q), approx8, scale, vsq, valid,
+                    max(r, k), metric, topk_mode,
+                )
         else:
             if self._dirty or self._bucket_resid8 is None:
                 self._publish()
