@@ -159,7 +159,13 @@ type SearchRequest struct {
 	IndexParams map[string]any `json:"index_params,omitempty"`
 	Ranker      map[string]any `json:"ranker,omitempty"`
 	LoadBalance string         `json:"load_balance,omitempty"`
-	Trace       bool           `json:"trace,omitempty"`
+	// Sort orders the top-k result set by scalar fields; same forms as
+	// the REST surface: "field" | [{"field": "asc"}] |
+	// [{"field": {"order": "desc", "missing": "_last"}}].
+	Sort     any  `json:"sort,omitempty"`
+	PageSize int  `json:"page_size,omitempty"`
+	PageNum  int  `json:"page_num,omitempty"`
+	Trace    bool `json:"trace,omitempty"`
 }
 
 // Hit is one search result row (dynamic fields ride alongside).
@@ -257,6 +263,14 @@ func (c *Client) Search(req SearchRequest) ([][]Hit, error) {
 // Query fetches documents by id or by scalar filter with pagination.
 func (c *Client) Query(db, space string, ids []string,
 	filters map[string]any, limit, offset int) ([]Hit, error) {
+	return c.QuerySorted(db, space, ids, filters, limit, offset, nil)
+}
+
+// QuerySorted is Query with a scalar-field sort spec (same forms as
+// search; "_score" is invalid for query). Cross-partition pages stay
+// consistent: the router merges globally before slicing.
+func (c *Client) QuerySorted(db, space string, ids []string,
+	filters map[string]any, limit, offset int, sort any) ([]Hit, error) {
 	body := map[string]any{
 		"db_name": db, "space_name": space,
 		"limit": limit, "offset": offset,
@@ -266,6 +280,9 @@ func (c *Client) Query(db, space string, ids []string,
 	}
 	if filters != nil {
 		body["filters"] = filters
+	}
+	if sort != nil {
+		body["sort"] = sort
 	}
 	var out struct {
 		Documents []Hit `json:"documents"`
@@ -332,4 +349,294 @@ func (c *Client) DropAlias(alias string) error {
 // IsLive reports whether the cluster answers health checks.
 func (c *Client) IsLive() bool {
 	return c.do("GET", "/cluster/health", nil, nil) == nil
+}
+
+// -- space update / detail ---------------------------------------------------
+
+// UpdateSpace applies online space changes (partition_num expansion,
+// new scalar fields) via PUT /dbs/{db}/spaces/{space}.
+func (c *Client) UpdateSpace(db, space string,
+	config map[string]any) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("PUT", "/dbs/"+db+"/spaces/"+space, config, &out)
+	return out, err
+}
+
+// SpaceDetail returns space metadata with per-partition doc/size/status
+// stats (GET ?detail=true).
+func (c *Client) SpaceDetail(db, space string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/dbs/"+db+"/spaces/"+space+"?detail=true",
+		nil, &out)
+	return out, err
+}
+
+// -- scalar field indexes ----------------------------------------------------
+
+// AddFieldIndex builds a scalar index (INVERTED/BITMAP) on field,
+// cluster-wide. background=false blocks until built.
+func (c *Client) AddFieldIndex(db, space, field, indexType string,
+	background bool) error {
+	return c.do("POST", "/field_index", map[string]any{
+		"db_name": db, "space_name": space, "field": field,
+		"index_type": indexType, "background": background,
+	}, nil)
+}
+
+// RemoveFieldIndex drops a scalar index from field.
+func (c *Client) RemoveFieldIndex(db, space, field string) error {
+	return c.do("POST", "/field_index", map[string]any{
+		"db_name": db, "space_name": space, "field": field,
+		"index_type": "NONE",
+	}, nil)
+}
+
+// -- backup / restore --------------------------------------------------------
+
+// BackupCreate starts an async backup job to storeRoot (local/NFS path)
+// or store (s3 spec); poll BackupJob with the returned job_id.
+func (c *Client) BackupCreate(db, space string, storeRoot string,
+	store map[string]any) (map[string]any, error) {
+	body := map[string]any{"command": "create", "async": true}
+	if storeRoot != "" {
+		body["store_root"] = storeRoot
+	}
+	if store != nil {
+		body["store"] = store
+	}
+	var out map[string]any
+	err := c.do("POST", "/backup/dbs/"+db+"/spaces/"+space, body, &out)
+	return out, err
+}
+
+// BackupJob polls an async backup job's progress record.
+func (c *Client) BackupJob(jobID string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/backup/jobs/"+jobID, nil, &out)
+	return out, err
+}
+
+// BackupList returns the stored backup versions for the space.
+func (c *Client) BackupList(db, space string, storeRoot string,
+	store map[string]any) ([]int, error) {
+	body := map[string]any{"command": "list"}
+	if storeRoot != "" {
+		body["store_root"] = storeRoot
+	}
+	if store != nil {
+		body["store"] = store
+	}
+	var out struct {
+		Versions []int `json:"versions"`
+	}
+	err := c.do("POST", "/backup/dbs/"+db+"/spaces/"+space, body, &out)
+	return out.Versions, err
+}
+
+// BackupRestore rewinds the space to a stored version (verify-then-swap
+// on every replica).
+func (c *Client) BackupRestore(db, space string, version int,
+	storeRoot string, store map[string]any) (map[string]any, error) {
+	body := map[string]any{"command": "restore", "version": version}
+	if storeRoot != "" {
+		body["store_root"] = storeRoot
+	}
+	if store != nil {
+		body["store"] = store
+	}
+	var out map[string]any
+	err := c.do("POST", "/backup/dbs/"+db+"/spaces/"+space, body, &out)
+	return out, err
+}
+
+// BackupDelete removes a stored version (ref-counted blob GC).
+func (c *Client) BackupDelete(db, space string, version int,
+	storeRoot string, store map[string]any) error {
+	body := map[string]any{"command": "delete", "version": version}
+	if storeRoot != "" {
+		body["store_root"] = storeRoot
+	}
+	if store != nil {
+		body["store"] = store
+	}
+	return c.do("POST", "/backup/dbs/"+db+"/spaces/"+space, body, nil)
+}
+
+// -- cluster views / ops -----------------------------------------------------
+
+// ClusterStats returns per-node partition stats (heartbeat-fed).
+func (c *Client) ClusterStats() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/cluster/stats", nil, &out)
+	return out, err
+}
+
+// ClusterHealth returns the per-space green/yellow/red roll-up.
+func (c *Client) ClusterHealth() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/cluster/health", nil, &out)
+	return out, err
+}
+
+// Members lists the metadata-raft masters with the leader flag.
+func (c *Client) Members() ([]map[string]any, error) {
+	var out struct {
+		Members []map[string]any `json:"members"`
+	}
+	err := c.do("GET", "/members", nil, &out)
+	return out.Members, err
+}
+
+// MemberAdd registers a new master {node_id, addr} with the group.
+func (c *Client) MemberAdd(nodeID int, addr string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("POST", "/members/add",
+		map[string]any{"node_id": nodeID, "addr": addr}, &out)
+	return out, err
+}
+
+// MemberRemove removes a master from the group.
+func (c *Client) MemberRemove(nodeID int) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("POST", "/members/remove",
+		map[string]any{"node_id": nodeID}, &out)
+	return out, err
+}
+
+// Servers lists registered PS nodes.
+func (c *Client) Servers() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/servers", nil, &out)
+	return out, err
+}
+
+// Routers lists live router instances.
+func (c *Client) Routers() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/routers", nil, &out)
+	return out, err
+}
+
+// Partitions lists every partition cluster-wide.
+func (c *Client) Partitions() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/partitions", nil, &out)
+	return out, err
+}
+
+// ChangeMember moves a partition replica: method is "add" or "remove".
+func (c *Client) ChangeMember(partitionID, nodeID int,
+	method string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("POST", "/partitions/change_member", map[string]any{
+		"partition_id": partitionID, "node_id": nodeID, "method": method,
+	}, &out)
+	return out, err
+}
+
+// FailServers lists durable failure records awaiting recovery.
+func (c *Client) FailServers() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/schedule/fail_server", nil, &out)
+	return out, err
+}
+
+// ClearFailServer erases a failure record for node_id.
+func (c *Client) ClearFailServer(nodeID int) error {
+	return c.do("DELETE", fmt.Sprintf("/schedule/fail_server/%d", nodeID),
+		nil, nil)
+}
+
+// RecoverServer forces a recovery pass for a failed node.
+func (c *Client) RecoverServer(nodeID int) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("POST", "/schedule/recover_server",
+		map[string]any{"node_id": nodeID}, &out)
+	return out, err
+}
+
+// CleanLock clears expired space-mutation locks (admin escape hatch).
+func (c *Client) CleanLock() (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/clean_lock", nil, &out)
+	return out, err
+}
+
+// -- runtime config ----------------------------------------------------------
+
+// SetConfig updates runtime engine config for db/space cluster-wide
+// (cache sizes, refresh interval...).
+func (c *Client) SetConfig(db, space string,
+	config map[string]any) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("POST", "/config/"+db+"/"+space, config, &out)
+	return out, err
+}
+
+// GetConfig reads the stored runtime config for db/space.
+func (c *Client) GetConfig(db, space string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/config/"+db+"/"+space, nil, &out)
+	return out, err
+}
+
+// -- users / roles (RBAC) ----------------------------------------------------
+
+// CreateUser creates a user with a role.
+func (c *Client) CreateUser(name, password, role string) error {
+	return c.do("POST", "/users", map[string]any{
+		"name": name, "password": password, "role_name": role,
+	}, nil)
+}
+
+// GetUser fetches a user record.
+func (c *Client) GetUser(name string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/users/"+name, nil, &out)
+	return out, err
+}
+
+// UpdateUser changes a user's password and/or role.
+func (c *Client) UpdateUser(name string, fields map[string]any) error {
+	body := map[string]any{"name": name}
+	for k, v := range fields {
+		body[k] = v
+	}
+	return c.do("PUT", "/users", body, nil)
+}
+
+// DeleteUser removes a user.
+func (c *Client) DeleteUser(name string) error {
+	return c.do("DELETE", "/users/"+name, nil, nil)
+}
+
+// CreateRole creates a role with a privilege map
+// (resource -> ReadOnly|WriteOnly|WriteRead).
+func (c *Client) CreateRole(name string, privileges map[string]string) error {
+	return c.do("POST", "/roles", map[string]any{
+		"name": name, "privileges": privileges,
+	}, nil)
+}
+
+// UpdateRole replaces a role's privilege map.
+func (c *Client) UpdateRole(name string, privileges map[string]string) error {
+	return c.do("PUT", "/roles", map[string]any{
+		"name": name, "privileges": privileges,
+	}, nil)
+}
+
+// GetRole fetches a role record.
+func (c *Client) GetRole(name string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/roles/"+name, nil, &out)
+	return out, err
+}
+
+// -- aliases (read side) -----------------------------------------------------
+
+// GetAlias resolves one alias.
+func (c *Client) GetAlias(alias string) (map[string]any, error) {
+	var out map[string]any
+	err := c.do("GET", "/alias/"+alias, nil, &out)
+	return out, err
 }

@@ -216,6 +216,243 @@ public final class VearchTpuClient {
                 + ",\"space_name\":" + q(space) + "}");
     }
 
+
+    // -- sorted reads --------------------------------------------------------
+
+    /**
+     * Query with a scalar-field sort spec (same JSON forms as search:
+     * {@code "field"}, {@code [{"field":"asc"}]},
+     * {@code [{"field":{"order":"desc","missing":"_last"}}]}).
+     */
+    public String querySorted(String db, String space,
+            String filtersJson, int limit, int offset, String sortJson)
+            throws IOException, InterruptedException {
+        StringBuilder sb = new StringBuilder()
+                .append("{\"db_name\":").append(q(db))
+                .append(",\"space_name\":").append(q(space))
+                .append(",\"limit\":").append(limit)
+                .append(",\"offset\":").append(offset);
+        if (filtersJson != null) {
+            sb.append(",\"filters\":").append(filtersJson);
+        }
+        if (sortJson != null) {
+            sb.append(",\"sort\":").append(sortJson);
+        }
+        return call("POST", "/document/query", sb.append('}').toString());
+    }
+
+    // -- space update / detail ----------------------------------------------
+
+    /** Online space changes (partition_num expansion, new fields). */
+    public String updateSpace(String db, String space, String configJson)
+            throws IOException, InterruptedException {
+        return call("PUT", "/dbs/" + db + "/spaces/" + space, configJson);
+    }
+
+    /** Space metadata with per-partition stats (?detail=true). */
+    public String spaceDetail(String db, String space)
+            throws IOException, InterruptedException {
+        return call("GET",
+                "/dbs/" + db + "/spaces/" + space + "?detail=true", null);
+    }
+
+    // -- scalar field indexes ------------------------------------------------
+
+    /** indexType INVERTED/BITMAP builds; NONE removes. */
+    public String fieldIndex(String db, String space, String field,
+            String indexType, boolean background)
+            throws IOException, InterruptedException {
+        return call("POST", "/field_index",
+                "{\"db_name\":" + q(db) + ",\"space_name\":" + q(space)
+                        + ",\"field\":" + q(field)
+                        + ",\"index_type\":" + q(indexType)
+                        + ",\"background\":" + background + "}");
+    }
+
+    // -- backup / restore ----------------------------------------------------
+
+    /**
+     * Backup command: create/list/restore/delete. create defaults to an
+     * async job — poll {@link #backupJob(String)} with the returned
+     * job_id. storeJson names the destination:
+     * {@code {"store_root": "/path"}} or an s3 spec under "store".
+     */
+    public String backup(String db, String space, String command,
+            Integer version, String storeJson, boolean async)
+            throws IOException, InterruptedException {
+        StringBuilder sb = new StringBuilder()
+                .append("{\"command\":").append(q(command));
+        if (version != null) {
+            sb.append(",\"version\":").append(version);
+        }
+        if (async) {
+            sb.append(",\"async\":true");
+        }
+        if (storeJson != null && !storeJson.isEmpty()) {
+            // accept a braced JSON object (as documented) and merge its
+            // members into the body, matching the Go/Rust SDKs
+            String inner = storeJson.trim();
+            if (inner.startsWith("{") && inner.endsWith("}")) {
+                inner = inner.substring(1, inner.length() - 1).trim();
+            }
+            if (!inner.isEmpty()) {
+                sb.append(',').append(inner);
+            }
+        }
+        return call("POST", "/backup/dbs/" + db + "/spaces/" + space,
+                sb.append('}').toString());
+    }
+
+    /** Async backup-job progress record. */
+    public String backupJob(String jobId)
+            throws IOException, InterruptedException {
+        return call("GET", "/backup/jobs/" + jobId, null);
+    }
+
+    // -- aliases -------------------------------------------------------------
+
+    public String createAlias(String alias, String db, String space)
+            throws IOException, InterruptedException {
+        return call("POST", "/alias/" + alias + "/dbs/" + db
+                + "/spaces/" + space, null);
+    }
+
+    public String getAlias(String alias)
+            throws IOException, InterruptedException {
+        return call("GET", "/alias/" + alias, null);
+    }
+
+    public String dropAlias(String alias)
+            throws IOException, InterruptedException {
+        return call("DELETE", "/alias/" + alias, null);
+    }
+
+    // -- cluster views / ops -------------------------------------------------
+
+    public String clusterStats()
+            throws IOException, InterruptedException {
+        return call("GET", "/cluster/stats", null);
+    }
+
+    public String clusterHealth()
+            throws IOException, InterruptedException {
+        return call("GET", "/cluster/health", null);
+    }
+
+    public String members()
+            throws IOException, InterruptedException {
+        return call("GET", "/members", null);
+    }
+
+    public String memberAdd(int nodeId, String addr)
+            throws IOException, InterruptedException {
+        return call("POST", "/members/add",
+                "{\"node_id\":" + nodeId + ",\"addr\":" + q(addr) + "}");
+    }
+
+    public String memberRemove(int nodeId)
+            throws IOException, InterruptedException {
+        return call("POST", "/members/remove",
+                "{\"node_id\":" + nodeId + "}");
+    }
+
+    public String servers()
+            throws IOException, InterruptedException {
+        return call("GET", "/servers", null);
+    }
+
+    public String partitions()
+            throws IOException, InterruptedException {
+        return call("GET", "/partitions", null);
+    }
+
+    public String changeMember(int partitionId, int nodeId, String method)
+            throws IOException, InterruptedException {
+        return call("POST", "/partitions/change_member",
+                "{\"partition_id\":" + partitionId
+                        + ",\"node_id\":" + nodeId
+                        + ",\"method\":" + q(method) + "}");
+    }
+
+    public String failServers()
+            throws IOException, InterruptedException {
+        return call("GET", "/schedule/fail_server", null);
+    }
+
+    public String recoverServer(int nodeId)
+            throws IOException, InterruptedException {
+        return call("POST", "/schedule/recover_server",
+                "{\"node_id\":" + nodeId + "}");
+    }
+
+    // -- runtime config ------------------------------------------------------
+
+    public String setConfig(String db, String space, String configJson)
+            throws IOException, InterruptedException {
+        return call("POST", "/config/" + db + "/" + space, configJson);
+    }
+
+    public String getConfig(String db, String space)
+            throws IOException, InterruptedException {
+        return call("GET", "/config/" + db + "/" + space, null);
+    }
+
+    // -- users / roles (RBAC) ------------------------------------------------
+
+    public String createUser(String name, String password, String roleName)
+            throws IOException, InterruptedException {
+        return call("POST", "/users",
+                "{\"name\":" + q(name) + ",\"password\":" + q(password)
+                        + ",\"role_name\":" + q(roleName) + "}");
+    }
+
+    public String getUser(String name)
+            throws IOException, InterruptedException {
+        return call("GET", "/users/" + name, null);
+    }
+
+    public String deleteUser(String name)
+            throws IOException, InterruptedException {
+        return call("DELETE", "/users/" + name, null);
+    }
+
+    /** @param privilegesJson e.g. {@code {"ResourceAll":"ReadOnly"}} */
+    public String createRole(String name, String privilegesJson)
+            throws IOException, InterruptedException {
+        return call("POST", "/roles",
+                "{\"name\":" + q(name) + ",\"privileges\":"
+                        + privilegesJson + "}");
+    }
+
+    public String getRole(String name)
+            throws IOException, InterruptedException {
+        return call("GET", "/roles/" + name, null);
+    }
+
+
+    /** Online partition-rule admin: op ADD (with ruleJson) or DROP
+     * (with partitionName). */
+    public String partitionRule(String db, String space, String op,
+            String partitionName, String ruleJson)
+            throws IOException, InterruptedException {
+        StringBuilder sb = new StringBuilder()
+                .append("{\"db_name\":").append(q(db))
+                .append(",\"space_name\":").append(q(space))
+                .append(",\"operator_type\":").append(q(op));
+        if (partitionName != null) {
+            sb.append(",\"partition_name\":").append(q(partitionName));
+        }
+        if (ruleJson != null) {
+            sb.append(",\"partition_rule\":").append(ruleJson);
+        }
+        return call("POST", "/partitions/rule", sb.append('}').toString());
+    }
+
+    public String routers()
+            throws IOException, InterruptedException {
+        return call("GET", "/routers", null);
+    }
+
     public boolean isLive() {
         try {
             call("GET", "/cluster/health", null);

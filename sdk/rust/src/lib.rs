@@ -244,6 +244,210 @@ impl Client {
         })))
     }
 
+
+    // -- sorted reads --------------------------------------------------
+
+    /// Query with a scalar-field sort spec (same JSON forms as search:
+    /// `"field"`, `[{"field":"asc"}]`,
+    /// `[{"field":{"order":"desc","missing":"_last"}}]`).
+    pub fn query_sorted(&self, db: &str, space: &str, filters: Option<Value>,
+                        limit: i64, offset: i64, sort: Value)
+                        -> Result<Value> {
+        let mut body = json!({
+            "db_name": db, "space_name": space,
+            "limit": limit, "offset": offset, "sort": sort,
+        });
+        if let Some(f) = filters {
+            body["filters"] = f;
+        }
+        self.call("POST", "/document/query", Some(body))
+    }
+
+    // -- space update / detail -----------------------------------------
+
+    /// Online space changes (partition_num expansion, new fields).
+    pub fn update_space(&self, db: &str, space: &str, config: Value)
+                        -> Result<Value> {
+        self.call("PUT", &format!("/dbs/{db}/spaces/{space}"), Some(config))
+    }
+
+    /// Space metadata with per-partition stats (`?detail=true`).
+    pub fn space_detail(&self, db: &str, space: &str) -> Result<Value> {
+        self.call("GET",
+                  &format!("/dbs/{db}/spaces/{space}?detail=true"), None)
+    }
+
+    // -- scalar field indexes ------------------------------------------
+
+    /// `index_type` INVERTED/BITMAP builds; NONE removes.
+    pub fn field_index(&self, db: &str, space: &str, field: &str,
+                       index_type: &str, background: bool) -> Result<Value> {
+        self.call("POST", "/field_index", Some(json!({
+            "db_name": db, "space_name": space, "field": field,
+            "index_type": index_type, "background": background,
+        })))
+    }
+
+    // -- backup / restore ----------------------------------------------
+
+    /// Backup command create/list/restore/delete; `create` with
+    /// `async_job` runs as an async job — poll [`Client::backup_job`].
+    /// `store` is `{"store_root": "/path"}` or `{"store": {s3 spec}}`.
+    pub fn backup(&self, db: &str, space: &str, command: &str,
+                  version: Option<i64>, store: Value, async_job: bool)
+                  -> Result<Value> {
+        let mut body = json!({"command": command});
+        if let Some(v) = version {
+            body["version"] = json!(v);
+        }
+        if async_job {
+            body["async"] = json!(true);
+        }
+        if let Some(obj) = store.as_object() {
+            for (k, v) in obj {
+                body[k] = v.clone();
+            }
+        }
+        self.call("POST",
+                  &format!("/backup/dbs/{db}/spaces/{space}"), Some(body))
+    }
+
+    /// Async backup-job progress record.
+    pub fn backup_job(&self, job_id: &str) -> Result<Value> {
+        self.call("GET", &format!("/backup/jobs/{job_id}"), None)
+    }
+
+    // -- aliases -------------------------------------------------------
+
+    pub fn create_alias(&self, alias: &str, db: &str, space: &str)
+                        -> Result<Value> {
+        self.call("POST",
+                  &format!("/alias/{alias}/dbs/{db}/spaces/{space}"), None)
+    }
+
+    pub fn get_alias(&self, alias: &str) -> Result<Value> {
+        self.call("GET", &format!("/alias/{alias}"), None)
+    }
+
+    pub fn drop_alias(&self, alias: &str) -> Result<Value> {
+        self.call("DELETE", &format!("/alias/{alias}"), None)
+    }
+
+    // -- cluster views / ops -------------------------------------------
+
+    pub fn cluster_stats(&self) -> Result<Value> {
+        self.call("GET", "/cluster/stats", None)
+    }
+
+    pub fn cluster_health(&self) -> Result<Value> {
+        self.call("GET", "/cluster/health", None)
+    }
+
+    pub fn members(&self) -> Result<Value> {
+        self.call("GET", "/members", None)
+    }
+
+    pub fn member_add(&self, node_id: i64, addr: &str) -> Result<Value> {
+        self.call("POST", "/members/add", Some(json!({
+            "node_id": node_id, "addr": addr,
+        })))
+    }
+
+    pub fn member_remove(&self, node_id: i64) -> Result<Value> {
+        self.call("POST", "/members/remove", Some(json!({
+            "node_id": node_id,
+        })))
+    }
+
+    pub fn servers(&self) -> Result<Value> {
+        self.call("GET", "/servers", None)
+    }
+
+    pub fn partitions(&self) -> Result<Value> {
+        self.call("GET", "/partitions", None)
+    }
+
+    /// Moves a partition replica; `method` is `"add"` or `"remove"`.
+    pub fn change_member(&self, partition_id: i64, node_id: i64,
+                         method: &str) -> Result<Value> {
+        self.call("POST", "/partitions/change_member", Some(json!({
+            "partition_id": partition_id, "node_id": node_id,
+            "method": method,
+        })))
+    }
+
+    pub fn fail_servers(&self) -> Result<Value> {
+        self.call("GET", "/schedule/fail_server", None)
+    }
+
+    pub fn recover_server(&self, node_id: i64) -> Result<Value> {
+        self.call("POST", "/schedule/recover_server", Some(json!({
+            "node_id": node_id,
+        })))
+    }
+
+    // -- runtime config ------------------------------------------------
+
+    pub fn set_config(&self, db: &str, space: &str, config: Value)
+                      -> Result<Value> {
+        self.call("POST", &format!("/config/{db}/{space}"), Some(config))
+    }
+
+    pub fn get_config(&self, db: &str, space: &str) -> Result<Value> {
+        self.call("GET", &format!("/config/{db}/{space}"), None)
+    }
+
+    // -- users / roles (RBAC) ------------------------------------------
+
+    pub fn create_user(&self, name: &str, password: &str, role_name: &str)
+                       -> Result<Value> {
+        self.call("POST", "/users", Some(json!({
+            "name": name, "password": password, "role_name": role_name,
+        })))
+    }
+
+    pub fn get_user(&self, name: &str) -> Result<Value> {
+        self.call("GET", &format!("/users/{name}"), None)
+    }
+
+    pub fn delete_user(&self, name: &str) -> Result<Value> {
+        self.call("DELETE", &format!("/users/{name}"), None)
+    }
+
+    /// `privileges` e.g. `{"ResourceAll": "ReadOnly"}`.
+    pub fn create_role(&self, name: &str, privileges: Value)
+                       -> Result<Value> {
+        self.call("POST", "/roles", Some(json!({
+            "name": name, "privileges": privileges,
+        })))
+    }
+
+    pub fn get_role(&self, name: &str) -> Result<Value> {
+        self.call("GET", &format!("/roles/{name}"), None)
+    }
+
+
+    /// Online partition-rule admin: `op` ADD (with `rule`) or DROP
+    /// (with `partition_name`).
+    pub fn partition_rule(&self, db: &str, space: &str, op: &str,
+                          partition_name: Option<&str>,
+                          rule: Option<Value>) -> Result<Value> {
+        let mut body = json!({
+            "db_name": db, "space_name": space, "operator_type": op,
+        });
+        if let Some(p) = partition_name {
+            body["partition_name"] = json!(p);
+        }
+        if let Some(r) = rule {
+            body["partition_rule"] = r;
+        }
+        self.call("POST", "/partitions/rule", Some(body))
+    }
+
+    pub fn routers(&self) -> Result<Value> {
+        self.call("GET", "/routers", None)
+    }
+
     pub fn is_live(&self) -> bool {
         self.call("GET", "/cluster/health", None).is_ok()
     }

@@ -229,6 +229,14 @@ _EXTRA_VALID = {
     "code", "msg", "data",  # the response envelope itself
     "training_threshold", "refresh_interval_ms", "metric_type",
     "index_type", "store_type", "offset", "document_ids",
+    # r5 full-surface additions (sort/pagination, membership, backup
+    # jobs, RBAC, config, schedule ops) — all live server keys
+    "sort", "order", "missing", "page_size", "page_num", "_sort",
+    "node_id", "addr", "members", "leader",
+    "command", "version", "versions", "async", "job_id", "store_root",
+    "store", "status", "files_done", "files_total", "background",
+    "partition_id", "method",
+    "password", "role_name", "privileges", "name",
 }
 
 
@@ -320,3 +328,100 @@ def test_sdk_source_spells_wire_keys(recorded, sdk_file):
         f"{sdk_file} does not spell these wire strings (typo or missing "
         f"op): {missing}"
     )
+
+
+# -- full-route coverage vs OpenAPI (r4 review next-8) -----------------------
+#
+# Every route the OpenAPI document advertises must appear (as its static
+# prefix) in all three non-Python SDK sources. Routes with no SDK
+# surface anywhere (debug/metrics/PS-port internals) are excluded with
+# reasons.
+
+_ROUTE_EXCLUDES = {
+    "/metrics",        # Prometheus scrapers, not SDK clients
+    "/debug/stacks",   # operator debugging surface
+    "/ps/kill",        # PS-port internal (reference SDKs lack it too)
+    "/ps/requests",    # PS-port internal
+    "/cache/dbs",      # router cache introspection, internal
+    "/clean_lock",     # Go covers it; a JSON-string client adds no value
+    "/schedule/fail_server",  # DELETE variant covered via the list route
+}
+
+
+def _openapi_route_prefixes() -> list[str]:
+    """Static prefixes of every documented path ('/dbs/{db}/spaces' ->
+    '/dbs/', plus distinctive literal segments like '/spaces')."""
+    import re
+
+    with open(os.path.join(REPO, "api", "openapi.yaml")) as f:
+        paths = re.findall(r"^  (/[^\s:]+):", f.read(), re.M)
+    out = []
+    for p in paths:
+        static = p.split("{")[0].rstrip("/")
+        if not static:
+            continue
+        if any(static == e or static.startswith(e + "/")
+               for e in _ROUTE_EXCLUDES):
+            continue
+        out.append(static)
+    return sorted(set(out))
+
+
+@pytest.mark.parametrize("sdk_file", sorted(_KEY_EXTRACTORS))
+def test_sdk_covers_every_openapi_route(sdk_file):
+    with open(os.path.join(REPO, "sdk", sdk_file)) as f:
+        src = f.read()
+    missing = [r for r in _openapi_route_prefixes() if r not in src]
+    assert not missing, (
+        f"{sdk_file} lacks OpenAPI routes: {missing} — every documented "
+        "route must appear in all three SDKs (r4 review next-8)"
+    )
+
+
+def test_error_envelope_and_auth_header_shapes(tmp_path):
+    """The error envelope ({code, msg}) and BasicAuth header the three
+    SDKs implement, pinned against the live server."""
+    import base64
+    import urllib.request
+
+    from vearch_tpu.cluster.master import MasterServer
+
+    m = MasterServer(auth=True, root_password="pw")
+    m.start()
+    try:
+        # error envelope: wrong credentials -> 401 code + msg keys
+        req = urllib.request.Request(
+            f"http://{m.addr}/dbs", method="GET",
+            headers={"Authorization": "Basic " + base64.b64encode(
+                b"root:wrong").decode()})
+        body = json.loads(urllib.request.urlopen(req).read())
+        assert shape_of(body) == {"code": "int", "msg": "str"}
+        assert body["code"] == 401
+        # the exact header scheme all three SDKs build
+        req = urllib.request.Request(
+            f"http://{m.addr}/dbs", method="GET",
+            headers={"Authorization": "Basic " + base64.b64encode(
+                b"root:pw").decode()})
+        ok = json.loads(urllib.request.urlopen(req).read())
+        assert ok["code"] == 0 and "data" in ok
+        # 404 error envelope has the same shape
+        req = urllib.request.Request(
+            f"http://{m.addr}/dbs/nope", method="GET",
+            headers={"Authorization": "Basic " + base64.b64encode(
+                b"root:pw").decode()})
+        nf = json.loads(urllib.request.urlopen(req).read())
+        assert shape_of(nf) == {"code": "int", "msg": "str"}
+        assert nf["code"] == 404
+    finally:
+        m.stop()
+
+
+def test_all_sdks_spell_auth_and_envelope():
+    """Each SDK must build 'Authorization: Basic <b64(user:password)>'
+    and read the {code, msg, data} envelope."""
+    for sdk_file in _KEY_EXTRACTORS:
+        with open(os.path.join(REPO, "sdk", sdk_file)) as f:
+            src = f.read()
+        assert "Authorization" in src and "Basic " in src, sdk_file
+        for key in ("code", "msg", "data"):
+            assert _spells(src, key), (sdk_file, key)

@@ -987,7 +987,18 @@ class RouterServer:
                  "vector_value": body.get("vector_value", False)},
                 body.get("load_balance", "leader"))
 
-        futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
+        # explicit partition_id = a sampling read of ONE partition
+        # (reference: doc_query.go query-by-partition — inspect a
+        # shard's contents without ids)
+        targets = space.partitions
+        if body.get("partition_id") is not None:
+            pid = int(body["partition_id"])
+            by_id = {p.id: p for p in space.partitions}
+            if pid not in by_id:
+                raise RpcError(404, f"partition {pid} not in space")
+            targets = [by_id[pid]]
+
+        futures = [self._pool.submit(send_filter, p.id) for p in targets]
         docs = []
         for f in futures:
             docs.extend(f.result()["documents"])

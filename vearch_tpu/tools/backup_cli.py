@@ -85,14 +85,29 @@ def main(argv: list[str] | None = None) -> int:
             import time as _time
 
             poll_deadline = _time.time() + 3600.0
+            misses = 0
             while True:
                 if _time.time() > poll_deadline:
                     print("\ngave up polling after 1h; job may still be "
                           f"running: GET /backup/jobs/{job_id}",
                           file=sys.stderr)
                     return 1
-                job = rpc.call(args.master, "GET",
-                               f"/backup/jobs/{job_id}", auth=auth)
+                try:
+                    job = rpc.call(args.master, "GET",
+                                   f"/backup/jobs/{job_id}", auth=auth)
+                    misses = 0
+                except rpc.RpcError as e:
+                    # ride out leader failover / transient network; only
+                    # CONSECUTIVE 404s mean the job record is really
+                    # gone (master restarted — records are in-memory)
+                    misses = misses + 1 if e.code == 404 else 0
+                    if e.code == 404 and misses >= 5:
+                        print(f"\njob record lost ({e.msg}); the backup "
+                              "may still complete — check "
+                              "`backup_cli list` later", file=sys.stderr)
+                        return 1
+                    _time.sleep(1.0)
+                    continue
                 parts = job["partitions"]
                 line = " ".join(
                     f"p{pid}:{p['status']}"
