@@ -360,6 +360,51 @@ def main():
         len(got[q] & set(bi[q].tolist())) / 10 for q in range(batch)
     ]))
 
+    # -- Glove-like COSINE regime (r4 review missing-6: the bench never
+    # folded in an angular regime; real Glove is unreachable at zero
+    # egress, tests/datasets.py make_glove_like replicates its hard
+    # properties: norm spread correlated with cluster mass, low
+    # intrinsic dim) --------------------------------------------------
+    glove_diag = {}
+    try:
+        from tests.datasets import make_glove_like
+
+        gn, gd = (8_000, 32) if _dryrun() else (200_000, 100)
+        gbase, gq, ggt = make_glove_like(gn, d=gd, nq=64)
+        gparams = {"ncentroids": 32 if _dryrun() else 1024,
+                   "nsubvector": 8 if _dryrun() else 25,
+                   "training_threshold": 2 * gn}
+        gschema = TableSchema("glove", [
+            FieldSchema("emb", DataType.VECTOR, dimension=gd,
+                        index=IndexParams("IVFPQ", MetricType.COSINE,
+                                          gparams)),
+        ])
+        geng = Engine(gschema)
+        for i in range(0, gn, 50_000):
+            hi = min(i + 50_000, gn)
+            geng.upsert([{"_id": str(j), "emb": gbase[j]}
+                         for j in range(i, hi)])
+        geng.build_index()
+        greq = SearchRequest(vectors={"emb": gq}, k=10,
+                             include_fields=[],
+                             index_params={"rerank": 256})
+        geng.search(greq)  # compile
+        t0 = time.time()
+        gres = geng.search(greq)
+        g_dt = time.time() - t0
+        ggot = [[int(it.key) for it in r.items] for r in gres]
+        g_recall = float(np.mean([
+            len(set(ggot[q]) & set(ggt[q][:10].tolist())) / 10
+            for q in range(len(ggot))
+        ]))
+        glove_diag = {"glove_like_cosine": {
+            "n": gn, "d": gd, "qps_b64": round(64 / g_dt, 1),
+            "recall_at_10": round(g_recall, 4),
+        }}
+        geng.close()
+    except Exception as e:  # the angular block must never kill the
+        glove_diag = {"glove_like_cosine": {"error": str(e)}}  # headline
+
     cpu_qps, cpu_diag = cpu_ivfpq_qps(idx, queries)
     result = {
         "metric": _metric_name(batch),
@@ -375,6 +420,7 @@ def main():
     diag = {
         "recall_at_10": round(recall, 4),
         "phase_ms": phase_ms,
+        **glove_diag,
         **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),
         "latency_ms_b1": round(lat[1] * 1e3, 1),

@@ -1,0 +1,301 @@
+package io.vearchtpu.langchain4j;
+
+import dev.langchain4j.data.document.Metadata;
+import dev.langchain4j.data.embedding.Embedding;
+import dev.langchain4j.data.segment.TextSegment;
+import dev.langchain4j.store.embedding.EmbeddingMatch;
+import dev.langchain4j.store.embedding.EmbeddingSearchRequest;
+import dev.langchain4j.store.embedding.EmbeddingSearchResult;
+import dev.langchain4j.store.embedding.EmbeddingStore;
+
+import io.vearchtpu.VearchTpuClient;
+
+import java.io.IOException;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.UUID;
+
+/**
+ * LangChain4j {@link EmbeddingStore} backed by a vearch-tpu cluster
+ * (reference intent: sdk/integrations/langchain4j — upstream ships a
+ * Vearch example against the same REST surface; this adapter speaks it
+ * through {@link VearchTpuClient}).
+ *
+ * <p>The backing space needs a string field {@code text}, a vector
+ * field {@code embedding} (InnerProduct/cosine), and is created by
+ * {@link #ensureSpace(int)} when absent. Metadata entries are stored as
+ * top-level scalar fields.
+ *
+ * <p>NOTE: no JDK ships in this image; compile-verified by consumers
+ * (same policy as sdk/java, docs/PARITY.md).
+ */
+public final class VearchTpuEmbeddingStore implements EmbeddingStore<TextSegment> {
+
+    private final VearchTpuClient client;
+    private final String db;
+    private final String space;
+
+    public VearchTpuEmbeddingStore(VearchTpuClient client, String db,
+            String space) {
+        this.client = client;
+        this.db = db;
+        this.space = space;
+    }
+
+    /** Creates the backing db/space (FLAT InnerProduct) when absent. */
+    public void ensureSpace(int dimension) throws IOException,
+            InterruptedException {
+        try {
+            client.createDatabase(db);
+        } catch (VearchTpuClient.ApiException e) {
+            if (e.code != 409) {
+                throw e;  // only duplicates are fine; surface real errors
+            }
+        }
+        try {
+            client.createSpace(db, "{\"name\":\"" + space + "\","
+                    + "\"partition_num\":1,\"replica_num\":1,"
+                    + "\"fields\":["
+                    + "{\"name\":\"text\",\"data_type\":\"string\"},"
+                    + "{\"name\":\"embedding\",\"data_type\":\"vector\","
+                    + "\"dimension\":" + dimension + ","
+                    + "\"index\":{\"index_type\":\"FLAT\","
+                    + "\"metric_type\":\"Cosine\",\"params\":{}}}"
+                    + "]}");
+        } catch (VearchTpuClient.ApiException e) {
+            if (e.code != 409) {
+                throw e;
+            }
+        }
+    }
+
+    @Override
+    public String add(Embedding embedding) {
+        String id = UUID.randomUUID().toString();
+        add(id, embedding);
+        return id;
+    }
+
+    @Override
+    public void add(String id, Embedding embedding) {
+        upsertOne(id, embedding, null);
+    }
+
+    @Override
+    public String add(Embedding embedding, TextSegment segment) {
+        String id = UUID.randomUUID().toString();
+        upsertOne(id, embedding, segment);
+        return id;
+    }
+
+    @Override
+    public List<String> addAll(List<Embedding> embeddings) {
+        List<String> ids = new ArrayList<>(embeddings.size());
+        for (Embedding e : embeddings) {
+            ids.add(add(e));
+        }
+        return ids;
+    }
+
+    @Override
+    public List<String> addAll(List<Embedding> embeddings,
+            List<TextSegment> segments) {
+        // ONE batched upsert: the SDK takes a JSON array, and 10k
+        // chunks must not mean 10k round trips (review r5)
+        List<String> ids = new ArrayList<>(embeddings.size());
+        StringBuilder batch = new StringBuilder("[");
+        for (int i = 0; i < embeddings.size(); i++) {
+            String id = UUID.randomUUID().toString();
+            ids.add(id);
+            if (i > 0) batch.append(',');
+            batch.append(docJson(id, embeddings.get(i),
+                    segments == null ? null : segments.get(i)));
+        }
+        batch.append(']');
+        try {
+            client.upsert(db, space, batch.toString());
+        } catch (IOException e) {
+            throw new RuntimeException(e);
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+            throw new RuntimeException(e);
+        }
+        return ids;
+    }
+
+    @Override
+    public EmbeddingSearchResult<TextSegment> search(
+            EmbeddingSearchRequest request) {
+        StringBuilder feature = new StringBuilder("[");
+        float[] v = request.queryEmbedding().vector();
+        for (int i = 0; i < v.length; i++) {
+            if (i > 0) feature.append(',');
+            feature.append(v[i]);
+        }
+        feature.append(']');
+        try {
+            String data = client.search(db, space,
+                    "[{\"field\":\"embedding\",\"feature\":"
+                            + feature + "}]",
+                    request.maxResults(), null);
+            return new EmbeddingSearchResult<>(
+                    parseMatches(data, request.minScore()));
+        } catch (IOException e) {
+            throw new RuntimeException(e);
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+            throw new RuntimeException(e);
+        }
+    }
+
+    // -- helpers -------------------------------------------------------
+
+    private void upsertOne(String id, Embedding embedding,
+            TextSegment segment) {
+        try {
+            client.upsert(db, space,
+                    "[" + docJson(id, embedding, segment) + "]");
+        } catch (IOException e) {
+            throw new RuntimeException(e);
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+            throw new RuntimeException(e);
+        }
+    }
+
+    private static String docJson(String id, Embedding embedding,
+            TextSegment segment) {
+        StringBuilder doc = new StringBuilder("{\"_id\":\"")
+                .append(id).append("\",\"embedding\":[");
+        float[] v = embedding.vector();
+        for (int i = 0; i < v.length; i++) {
+            if (i > 0) doc.append(',');
+            doc.append(v[i]);
+        }
+        doc.append(']');
+        if (segment != null) {
+            doc.append(",\"text\":\"")
+                    .append(escape(segment.text())).append('"');
+        }
+        return doc.append('}').toString();
+    }
+
+    private List<EmbeddingMatch<TextSegment>> parseMatches(String data,
+            double minScore) {
+        // dependency-free, JSON-STRING-AWARE parse of
+        // {"documents": [[{"_id", "_score", "text"...}]]}: braces and
+        // brackets inside stored text must not confuse the depth
+        // counter (review r5)
+        List<EmbeddingMatch<TextSegment>> out = new ArrayList<>();
+        int rows = data.indexOf("[[");
+        if (rows < 0) {
+            return out;
+        }
+        String inner = data.substring(rows + 1);
+        int depth = 0;
+        int start = -1;
+        boolean inString = false;
+        boolean escaped = false;
+        for (int i = 0; i < inner.length(); i++) {
+            char ch = inner.charAt(i);
+            if (inString) {
+                if (escaped) {
+                    escaped = false;
+                } else if (ch == '\\') {
+                    escaped = true;
+                } else if (ch == '"') {
+                    inString = false;
+                }
+                continue;
+            }
+            if (ch == '"') {
+                inString = true;
+            } else if (ch == '{') {
+                if (depth == 0) start = i;
+                depth++;
+            } else if (ch == '}') {
+                depth--;
+                if (depth == 0 && start >= 0) {
+                    String obj = inner.substring(start, i + 1);
+                    String id = extract(obj, "_id");
+                    String score = extract(obj, "_score");
+                    String text = extract(obj, "text");
+                    double sc = score == null ? 0.0
+                            : Double.parseDouble(score);
+                    if (sc >= minScore) {
+                        out.add(new EmbeddingMatch<>(sc, id, null,
+                                text == null ? null
+                                        : TextSegment.from(text,
+                                                new Metadata())));
+                    }
+                }
+            } else if (ch == ']' && depth == 0) {
+                break;
+            }
+        }
+        return out;
+    }
+
+    private static String extract(String obj, String key) {
+        int i = obj.indexOf('"' + key + '"');
+        if (i < 0) {
+            return null;
+        }
+        int colon = obj.indexOf(':', i);
+        int j = colon + 1;
+        while (j < obj.length() && obj.charAt(j) == ' ') j++;
+        if (obj.charAt(j) == '"') {
+            // scan to the CLOSING quote honoring escapes, unescaping
+            // as we go (review r5: indexOf stopped at escaped quotes)
+            StringBuilder sb = new StringBuilder();
+            for (int k2 = j + 1; k2 < obj.length(); k2++) {
+                char c = obj.charAt(k2);
+                if (c == '\\' && k2 + 1 < obj.length()) {
+                    char nxt = obj.charAt(++k2);
+                    switch (nxt) {
+                        case 'n': sb.append('\n'); break;
+                        case 'r': sb.append('\r'); break;
+                        case 't': sb.append('\t'); break;
+                        default: sb.append(nxt);
+                    }
+                } else if (c == '"') {
+                    return sb.toString();
+                } else {
+                    sb.append(c);
+                }
+            }
+            return sb.toString();
+        }
+        int end = j;
+        while (end < obj.length()
+                && "-+.eE0123456789".indexOf(obj.charAt(end)) >= 0) {
+            end++;
+        }
+        return obj.substring(j, end);
+    }
+
+    private static String escape(String s) {
+        // full JSON string escaping incl. control characters (review
+        // r5: a newline in a document chunk must not break the upsert)
+        StringBuilder sb = new StringBuilder(s.length() + 8);
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '\\': sb.append("\\\\"); break;
+                case '"': sb.append("\\\""); break;
+                case '\n': sb.append("\\n"); break;
+                case '\r': sb.append("\\r"); break;
+                case '\t': sb.append("\\t"); break;
+                case '\b': sb.append("\\b"); break;
+                case '\f': sb.append("\\f"); break;
+                default:
+                    if (c < 0x20) {
+                        sb.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        sb.append(c);
+                    }
+            }
+        }
+        return sb.toString();
+    }
+}

@@ -1,0 +1,170 @@
+// Package vearchtpu implements a langchaingo vectorstores.VectorStore
+// backed by a vearch-tpu cluster (reference intent:
+// sdk/integrations/* ship framework adapters; langchaingo's Vearch
+// store upstream speaks the same REST surface this adapter does via
+// the Go SDK in sdk/go).
+//
+// Usage:
+//
+//	store, _ := vearchtpu.New(client, embedder,
+//	    vearchtpu.WithSpace("db", "docs", 768))
+//	store.AddDocuments(ctx, docs)
+//	hits, _ := store.SimilaritySearch(ctx, "query", 4)
+//
+// NOTE: no Go toolchain ships in this image; compile-verified by
+// consumers (same policy as sdk/go, docs/PARITY.md).
+package vearchtpu
+
+import (
+	"context"
+	"fmt"
+
+	"github.com/tmc/langchaingo/embeddings"
+	"github.com/tmc/langchaingo/schema"
+	"github.com/tmc/langchaingo/vectorstores"
+
+	vearch "github.com/vearch-tpu/sdk/go"
+)
+
+// Store adapts a vearch-tpu space to langchaingo's VectorStore.
+type Store struct {
+	client   *vearch.Client
+	embedder embeddings.Embedder
+	db       string
+	space    string
+	dim      int
+	textKey  string
+}
+
+var _ vectorstores.VectorStore = (*Store)(nil)
+
+// Option configures a Store.
+type Option func(*Store)
+
+// WithSpace names the target db/space and the embedding dimension.
+func WithSpace(db, space string, dim int) Option {
+	return func(s *Store) { s.db, s.space, s.dim = db, space, dim }
+}
+
+// WithTextKey overrides the scalar field storing the document text
+// (default "text").
+func WithTextKey(k string) Option {
+	return func(s *Store) { s.textKey = k }
+}
+
+// New builds a Store; EnsureSpace creates the backing space when absent.
+func New(client *vearch.Client, embedder embeddings.Embedder,
+	opts ...Option) (*Store, error) {
+	s := &Store{client: client, embedder: embedder, textKey: "text"}
+	for _, o := range opts {
+		o(s)
+	}
+	if s.db == "" || s.space == "" || s.dim == 0 {
+		return nil, fmt.Errorf("vearchtpu: WithSpace(db, space, dim) is required")
+	}
+	return s, nil
+}
+
+// EnsureSpace creates the database and space (FLAT Cosine + text field)
+// if they do not exist yet.
+func (s *Store) EnsureSpace() error {
+	_ = s.client.CreateDatabase(s.db) // idempotent-ish: exists -> error ignored
+	_, err := s.client.CreateSpace(s.db, vearch.SpaceConfig{
+		Name: s.space, PartitionNum: 1, ReplicaNum: 1,
+		Fields: []vearch.Field{
+			{Name: s.textKey, DataType: "string"},
+			{Name: "embedding", DataType: "vector", Dimension: s.dim,
+				Index: map[string]any{
+					"index_type": "FLAT", "metric_type": "Cosine",
+					"params": map[string]any{},
+				}},
+		},
+	})
+	if err != nil {
+		if apiErr, ok := err.(*vearch.APIError); ok && apiErr.Code == 409 {
+			return nil // already exists
+		}
+		return err
+	}
+	return nil
+}
+
+// AddDocuments embeds and upserts docs; returns assigned ids.
+func (s *Store) AddDocuments(ctx context.Context, docs []schema.Document,
+	_ ...vectorstores.Option) ([]string, error) {
+	texts := make([]string, len(docs))
+	for i, d := range docs {
+		texts[i] = d.PageContent
+	}
+	vecs, err := s.embedder.EmbedDocuments(ctx, texts)
+	if err != nil {
+		return nil, err
+	}
+	rows := make([]vearch.Document, len(docs))
+	for i, d := range docs {
+		row := vearch.Document{
+			s.textKey: d.PageContent, "embedding": vecs[i],
+		}
+		for k, v := range d.Metadata {
+			row[k] = v
+		}
+		rows[i] = row
+	}
+	return s.client.Upsert(s.db, s.space, rows)
+}
+
+// SimilaritySearch embeds the query and returns the top numDocuments
+// segments as schema.Documents with the similarity score attached.
+// vectorstores.WithScoreThreshold is honored (hits below it are
+// dropped); WithFilters expects the server's filter AST
+// ({operator, conditions}) and rides the request as-is.
+func (s *Store) SimilaritySearch(ctx context.Context, query string,
+	numDocuments int, options ...vectorstores.Option) ([]schema.Document, error) {
+	opts := vectorstores.Options{}
+	for _, o := range options {
+		o(&opts)
+	}
+	qv, err := s.embedder.EmbedQuery(ctx, query)
+	if err != nil {
+		return nil, err
+	}
+	req := vearch.SearchRequest{
+		DBName: s.db, SpaceName: s.space,
+		Vectors: []vearch.SearchVector{{Field: "embedding", Feature: qv}},
+		Limit:   numDocuments,
+	}
+	if f, ok := opts.Filters.(map[string]any); ok {
+		req.Filters = f
+	}
+	hits, err := s.client.Search(req)
+	if err != nil {
+		return nil, err
+	}
+	if len(hits) == 0 {
+		return nil, nil
+	}
+	out := make([]schema.Document, 0, len(hits[0]))
+	for _, h := range hits[0] {
+		if opts.ScoreThreshold > 0 &&
+			float32(h.Score()) < opts.ScoreThreshold {
+			continue
+		}
+		text, _ := h[s.textKey].(string)
+		meta := map[string]any{}
+		for k, v := range h {
+			if k != s.textKey && k != "_id" && k != "_score" {
+				meta[k] = v
+			}
+		}
+		out = append(out, schema.Document{
+			PageContent: text, Metadata: meta, Score: float32(h.Score()),
+		})
+	}
+	return out, nil
+}
+
+// RemoveByIDs deletes documents by id (langchaingo has no standard
+// delete; exposed for parity with the other adapters).
+func (s *Store) RemoveByIDs(_ context.Context, ids []string) (int, error) {
+	return s.client.Delete(s.db, s.space, ids, nil, -1)
+}

@@ -125,3 +125,51 @@ def make_gist_like(n: int = 10_000, d: int = 960, nq: int = 32,
     return base.astype(np.float32), queries.astype(np.float32), _exact_gt(
         queries, base
     )
+
+
+def _exact_gt_cosine(queries: np.ndarray, base: np.ndarray, k: int = 100):
+    q = queries.astype(np.float64)
+    b = base.astype(np.float64)
+    qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+    bn = b / np.maximum(np.linalg.norm(b, axis=1, keepdims=True), 1e-12)
+    sims = qn @ bn.T
+    return np.argsort(-sims, axis=1)[:, :k]
+
+
+def make_glove_like(n: int, d: int = 100, nq: int = 32, seed: int = 23):
+    """Glove-100-angular-shaped config (reference gates recall on
+    Glove, test/test_recall_baseline.py + data_utils.py:295): word
+    embeddings under COSINE with the properties that make angular
+    search hard —
+
+    - norm spread correlated with cluster mass (frequent-word clusters
+      have larger, tighter-normed vectors), so L2 and angular
+      neighborhoods disagree and only a cosine-correct pipeline gates;
+    - low-ish intrinsic dimension (rank ~48 mixing) with heavy-tailed
+      coordinate scales, like trained embedding matrices;
+    - in-distribution queries (the Glove query set is held-out words).
+
+    Ground truth is the exact float64 COSINE scan.
+    """
+    rng = np.random.default_rng(seed)
+    intrinsic = 48
+    mix = rng.standard_normal((intrinsic, d)).astype(np.float32)
+    mix *= (np.arange(1, d + 1, dtype=np.float32) ** -0.3)[None, :]
+    nc = max(n // 150, 16)
+    w = 1.0 / np.arange(1, nc + 1) ** 1.05
+    w /= w.sum()
+    which = rng.choice(nc, n, p=w)
+    z_centers = (rng.standard_normal((nc, intrinsic)) * 2.0).astype(
+        np.float32)
+    z = z_centers[which] + 0.6 * rng.standard_normal(
+        (n, intrinsic)).astype(np.float32)
+    base = (z @ mix).astype(np.float32)
+    # norm ~ cluster frequency: head-cluster rows get larger norms
+    freq_rank = np.argsort(np.argsort(-w))  # 0 = most massive
+    norm_scale = (1.0 + 2.0 / (1.0 + freq_rank[which])).astype(np.float32)
+    base *= norm_scale[:, None]
+    q_idx = rng.choice(n, nq, replace=False)
+    queries = base[q_idx] + 0.1 * rng.standard_normal(
+        (nq, d)).astype(np.float32)
+    return base, queries.astype(np.float32), _exact_gt_cosine(
+        queries, base)

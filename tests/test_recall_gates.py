@@ -273,3 +273,39 @@ def test_zz_recall_matrix():
         r = RESULTS[(name, regime)]
         print(f"  {name:<14} {regime:<7} "
               f"{r[1]:.3f} / {r[10]:.3f} / {r.get(100, float('nan')):.3f}")
+
+
+# -- Glove-like COSINE regime (reference gates Glove-100-angular;
+#    r4 review missing-6: the gates never ran a cosine regime) --------------
+
+@pytest.fixture(scope="module")
+def glove_data():
+    from tests.datasets import make_glove_like
+
+    return make_glove_like(N, d=64, nq=NQ)
+
+
+@pytest.mark.parametrize("index_type,build,sp", [
+    # rerank >= 512: recall@100 needs the exact-rerank window to hold
+    # well over 100 candidates. This regime caught a real bug: without
+    # re-normalizing the PQ approximations for cosine, the IP scan
+    # ranked by norm error and r@100 was 0.465 (index/ivf.py
+    # _absorb_rows)
+    ("IVFPQ", {"ncentroids": 64, "nsubvector": 8},
+     {"nprobe": 16, "rerank": 512}),
+    # probe mode shares the bug class (publish-path buckets needed the
+    # same renormalization as the mirror — review r5)
+    ("IVFPQ-probe", {"ncentroids": 64, "nsubvector": 8},
+     {"scan_mode": "probe", "nprobe": 24, "rerank": 512}),
+    ("IVFFLAT", {"ncentroids": 64}, {"nprobe": 16}),
+    ("HNSW", {"nlinks": 32}, {"efSearch": 80}),
+    ("FLAT", {}, {}),
+])
+def test_recall_gates_glove_cosine(glove_data, index_type, build, sp):
+    base, queries, gt = glove_data
+    params = dict(build)
+    params["training_threshold"] = len(base)
+    eng = build_engine(
+        IndexParams(index_type.split("-")[0], MetricType.COSINE, params),
+        base)
+    gate(eng, glove_data, index_type, "glove-cosine", sp or None)

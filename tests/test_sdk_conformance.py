@@ -425,3 +425,41 @@ def test_all_sdks_spell_auth_and_envelope():
         assert "Authorization" in src and "Basic " in src, sdk_file
         for key in ("code", "msg", "data"):
             assert _spells(src, key), (sdk_file, key)
+
+
+# -- framework integrations (reference: sdk/integrations/*) ------------------
+
+def test_integration_adapters_use_real_sdk_methods():
+    """langchaingo / LangChain4j adapters are source-only (no
+    toolchains); pin them to the SDK surface they call so an SDK rename
+    breaks HERE, not in a consumer's build."""
+    import re
+
+    go_sdk = open(os.path.join(REPO, "sdk", "go", "client.go")).read()
+    go_methods = set(re.findall(r"func \(c \*Client\) (\w+)\(", go_sdk))
+    adapter = open(os.path.join(
+        REPO, "sdk", "integrations", "langchaingo", "vearchtpu.go")).read()
+    called = set(re.findall(r"\.client\.(\w+)\(", adapter))
+    assert called and called <= go_methods, (
+        f"langchaingo adapter calls unknown Go SDK methods: "
+        f"{sorted(called - go_methods)}"
+    )
+    # struct fields the adapter reads from SDK types must exist
+    for name in ("SpaceConfig", "SearchVector", "SearchRequest",
+                 "Document", "APIError"):
+        assert f"vearch.{name}" in adapter or name in go_methods, name
+
+    java_sdk = open(os.path.join(
+        REPO, "sdk", "java", "src", "main", "java", "io", "vearchtpu",
+        "VearchTpuClient.java")).read()
+    java_methods = set(re.findall(
+        r"public \w+(?:<[^>]+>)? (\w+)\(", java_sdk))
+    j_adapter = open(os.path.join(
+        REPO, "sdk", "integrations", "langchain4j", "src", "main",
+        "java", "io", "vearchtpu", "langchain4j",
+        "VearchTpuEmbeddingStore.java")).read()
+    j_called = set(re.findall(r"client\.(\w+)\(", j_adapter))
+    assert j_called and j_called <= java_methods, (
+        f"langchain4j adapter calls unknown Java SDK methods: "
+        f"{sorted(j_called - java_methods)}"
+    )

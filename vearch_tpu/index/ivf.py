@@ -446,6 +446,14 @@ class IVFPQIndex(_IVFBase):
         if self._opq_R is not None:
             decoded = decoded @ self._opq_R.T
         approx = cents[assign] + decoded
+        if self.metric is MetricType.COSINE:
+            # re-normalize the approximation: rows were normalized
+            # before encoding, but PQ error perturbs the norm, and the
+            # IP scan would rank by (1 ± err) * cos — on norm-spread
+            # data (glove-like regime) that bias alone cost r@100
+            # 0.465 -> the candidate set was norm-noise, not angle
+            approx = approx / np.maximum(
+                np.linalg.norm(approx, axis=1, keepdims=True), 1e-12)
         self._mirror.append(approx, start=start_docid)
 
     def _publish(self) -> None:
@@ -474,6 +482,16 @@ class IVFPQIndex(_IVFBase):
             decoded = pq_ops.decode_pq_np(codes, self.codebooks)
             if self._opq_R is not None:
                 decoded = decoded @ self._opq_R.T  # back to original space
+            if self.metric is MetricType.COSINE:
+                # same re-normalization as the mirror path (review r5):
+                # redefine the residual against the NORMALIZED
+                # approximation so the probe scan's cent_c + s*r8
+                # decomposition reconstructs a unit-norm vector — PQ
+                # norm error must not rank cosine candidates
+                full = cents[c][None, :] + decoded
+                full /= np.maximum(
+                    np.linalg.norm(full, axis=1, keepdims=True), 1e-12)
+                decoded = full - cents[c][None, :]
             scale = max(float(np.abs(decoded).max()) / 127.0, 1e-12)
             q8 = np.clip(np.rint(decoded / scale), -127, 127).astype(np.int8)
             approx = cents[c][None, :] + scale * q8.astype(np.float32)
