@@ -262,8 +262,12 @@ def main():
     print(f"build done {t_build:.0f}s", file=sys.stderr, flush=True)
 
     idx = eng.indexes["emb"]
+    # raw_results: the columnar serving shape (what the PS wire path
+    # consumes) — building b*k python result objects was ~50ms of
+    # host time at b=1024 that a TPU-speed kernel cannot hide
     req = SearchRequest(vectors={"emb": queries[:batch]}, k=10,
-                        include_fields=[], index_params={"rerank": 128})
+                        include_fields=[], raw_results=True,
+                        index_params={"rerank": 128})
     eng.search(req)  # compile
     t0 = time.time()
     iters = 5
@@ -277,7 +281,7 @@ def main():
     lat = {}
     for b in (1, 32):
         req_b = SearchRequest(vectors={"emb": queries[:b]}, k=10,
-                              include_fields=[],
+                              include_fields=[], raw_results=True,
                               index_params={"rerank": 128})
         eng.search(req_b)  # compile this batch shape
         times = []
@@ -355,7 +359,7 @@ def main():
         MetricType.L2, sqn,
     )
     bi = np.asarray(bi)
-    got = [{int(it.key[1:]) for it in r.items} for r in res]
+    got = [{int(k[1:]) for k in ks} for ks in res.keys]
     recall = float(np.mean([
         len(got[q] & set(bi[q].tolist())) / 10 for q in range(batch)
     ]))

@@ -192,3 +192,32 @@ def test_multi_vector_field_weighted_merge(rng):
     ref = 0.3 * (va @ q) + 0.7 * (vb @ q)
     for i in range(10):
         assert got[f"d{i}"] == pytest.approx(float(ref[i]), abs=1e-4)
+
+
+def test_raw_results_columnar_path_matches_items(engine_with_docs):
+    """raw_results returns the columnar serving shape with EXACTLY the
+    item path's keys and scores (r5: b*k result objects were ~50ms of
+    host time at b=1024 — the wire path now skips them engine-deep)."""
+    from vearch_tpu.engine.types import ColumnarSearchResults
+
+    eng, vecs = engine_with_docs
+    item_res = eng.search(SearchRequest(
+        vectors={"emb": vecs[:6]}, k=5, include_fields=[]))
+    raw = eng.search(SearchRequest(
+        vectors={"emb": vecs[:6]}, k=5, include_fields=[],
+        raw_results=True))
+    assert isinstance(raw, ColumnarSearchResults)
+    assert raw.keys == [[it.key for it in r.items] for r in item_res]
+    flat_item_scores = [it.score for r in item_res for it in r.items]
+    np.testing.assert_allclose(raw.scores, flat_item_scores, rtol=1e-6)
+    # filters ride the raw path too
+    filt = {"operator": "AND", "conditions": [
+        {"field": "price", "operator": "<", "value": 10}]}
+    raw_f = eng.search(SearchRequest(
+        vectors={"emb": vecs[:2]}, k=5, include_fields=[],
+        filters=filt, raw_results=True))
+    assert all(float(k[3:]) < 10 for ks in raw_f.keys for k in ks)
+    # requests that need fields or sort keep the item shape
+    full = eng.search(SearchRequest(
+        vectors={"emb": vecs[:2]}, k=3, raw_results=True))
+    assert not isinstance(full, ColumnarSearchResults)

@@ -267,9 +267,11 @@ def test_multimaster_with_auth(tmp_path):
         # heartbeat-fed GETs served on a follower forward to the leader
         # WITH the caller's credentials (advisor r4: _leader_get used to
         # drop the Authorization header and the leader 401'd these)
-        out = rpc.call(follower.addr, "GET", "/cluster/stats", auth=root)
+        out = call_retry(follower.addr, "GET", "/cluster/stats",
+                         auth=root)
         assert "stats" in out
-        out = rpc.call(follower.addr, "GET", "/cluster/health", auth=root)
+        out = call_retry(follower.addr, "GET", "/cluster/health",
+                         auth=root)
         assert out["status"] in ("green", "yellow", "red")
     finally:
         for m in masters:

@@ -93,6 +93,8 @@ class FaultyNet:
             return node.handle_install_snapshot(body)
         if path.endswith("/fence"):
             return node.handle_fence(int(body["term"]))
+        if path.endswith("/vote"):
+            return node.handle_vote(body)
         raise AssertionError(f"unknown route {path}")
 
 
@@ -266,7 +268,11 @@ def _run_schedule(tmp_path, seed: int) -> None:
                     removed.add(r)
 
     stop.set()
-    t.join(timeout=10.0)
+    # the writer MUST be dead before checking: an in-flight propose
+    # completing mid-check mutates logs/acked under the assertions
+    # (observed as a spurious divergence under full-suite load)
+    t.join(timeout=60.0)
+    assert not t.is_alive(), f"seed {seed}: writer stuck in propose"
     assert not writer_err, f"seed {seed}: writer crashed: {writer_err[0]}"
 
     # -- convergence: heal, reconcile until a leader exists, marker op --
@@ -323,3 +329,186 @@ def test_adversarial_schedules(tmp_path, batch):
     for i in range(N_SCHEDULES // 10):
         seed = batch * 1000 + i
         _run_schedule(tmp_path / f"s{seed}", seed)
+
+
+# -- voted-election mode (the metadata group's protocol) ---------------------
+
+class VotedCluster:
+    """3 voted-raft replicas (election_timeout mode — the metadata
+    group's protocol, including the post-election no-op and §5.4.2
+    current-term commit counting) under the same FaultyNet."""
+
+    def __init__(self, tmp_path, rng):
+        self.net = FaultyNet(rng)
+        self.states: dict[int, list] = {}
+        self.nodes: dict[int, RaftNode] = {}
+        self.committers: dict[int, set] = {}
+        self._commit_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.tick_errors: list = []
+        for nid in (1, 2, 3):
+            self._make_node(tmp_path, nid)
+        self._tickers = [
+            threading.Thread(target=self._tick_loop, args=(n,), daemon=True)
+            for n in self.nodes.values()
+        ]
+        for t in self._tickers:
+            t.start()
+
+    def _make_node(self, tmp_path, nid: int):
+        ops: list = []
+        self.states[nid] = ops
+
+        def apply_fn(op):
+            ops.append(op)
+            return True
+
+        def snapshot_fn():
+            return json.dumps(ops).encode(), node.applied
+
+        def install_fn(data, _idx):
+            ops[:] = json.loads(data.decode())
+
+        node = RaftNode(
+            pid=1, node_id=nid, wal_dir=str(tmp_path / f"v{nid}"),
+            apply_fn=apply_fn,
+            send_fn=lambda peer, path, body, _s=nid: self.net.send(
+                _s, peer, path, body),
+            members=[1, 2, 3], is_leader=False,
+            snapshot_fn=snapshot_fn, install_fn=install_fn,
+            quorum_timeout=1.5, election_timeout=0.3,
+        )
+        self.nodes[nid] = node
+        self.net.nodes[nid] = node
+        return node
+
+    def _tick_loop(self, node: RaftNode):
+        while not self._stop.is_set():
+            try:
+                node.election_tick()
+                if node.is_leader:
+                    node.tick()
+            except RpcError:
+                pass  # faulty network is the point
+            except Exception as e:  # real protocol bugs must SURFACE
+                if not self._stop.is_set():
+                    self.tick_errors.append(
+                        f"node {node.node_id}: {type(e).__name__}: {e}")
+            time.sleep(0.08)
+
+    def leader(self) -> RaftNode | None:
+        leaders = [n for n in self.nodes.values() if n.is_leader]
+        return leaders[0] if len(leaders) == 1 else None
+
+    def close(self):
+        self._stop.set()
+        for n in self.nodes.values():
+            n.close()
+
+
+def _run_voted_schedule(tmp_path, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    cluster = VotedCluster(tmp_path, rng)
+    net = cluster.net
+    acked: list[dict] = []
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set() and i < 40:
+            op = {"v": seed, "op": i}
+            target = cluster.leader()
+            if target is None:
+                # sometimes poke a random node (a stale leader or a
+                # follower that must 421)
+                if rng.random() < 0.3:
+                    target = cluster.nodes[int(rng.choice([1, 2, 3]))]
+                else:
+                    time.sleep(0.02)
+                    continue
+            # capture the term BEFORE proposing (same discipline as the
+            # data-mode harness): after propose() returns, an election
+            # may already have bumped target.term, mis-attributing the
+            # commit and flaking the one-committer-per-term check
+            term = target.term
+            try:
+                target.propose([op])
+                with cluster._commit_lock:
+                    cluster.committers.setdefault(
+                        term, set()).add(target.node_id)
+                acked.append(op)
+                i += 1
+            except RpcError:
+                time.sleep(0.005)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    for _ in range(int(rng.integers(3, 6))):
+        time.sleep(float(rng.uniform(0.05, 0.2)))
+        ev = rng.random()
+        if ev < 0.4:
+            net.drop_p = float(rng.uniform(0.05, 0.35))
+            net.delay_p = float(rng.uniform(0.1, 0.4))
+            net.dup_p = float(rng.uniform(0.0, 0.25))
+        elif ev < 0.75:
+            # isolate the current leader: the rest must elect a new one
+            lead = cluster.leader()
+            if lead is not None:
+                for other in cluster.nodes:
+                    if other != lead.node_id:
+                        net.blocked.add((lead.node_id, other))
+        else:
+            net.heal()
+    stop.set()
+    t.join(timeout=60.0)
+    assert not t.is_alive(), f"voted seed {seed}: writer stuck"
+    net.heal()
+
+    # convergence: elected leader commits a marker; all nodes apply it
+    marker = {"v": seed, "marker": True}
+    deadline = time.time() + 25.0
+    while time.time() < deadline:
+        lead = cluster.leader()
+        if lead is not None:
+            try:
+                lead.propose([marker])
+                break
+            except RpcError:
+                pass
+        time.sleep(0.05)
+    else:
+        cluster.close()
+        pytest.fail(f"voted seed {seed}: no leader after heal")
+    deadline = time.time() + 15.0
+    while time.time() < deadline and not all(
+        s and s[-1] == marker for s in cluster.states.values()
+    ):
+        time.sleep(0.05)
+
+    final = max(cluster.states.values(), key=len)
+    try:
+        for nid, ops in cluster.states.items():
+            assert ops[-1] == marker, f"voted seed {seed}: {nid} lagged"
+            assert ops == final[:len(ops)], (
+                f"voted seed {seed}: node {nid} diverged")
+        have = {json.dumps(o, sort_keys=True) for o in final}
+        for op in acked:
+            assert json.dumps(op, sort_keys=True) in have, (
+                f"voted seed {seed}: ACKED {op} lost")
+        for term, who in cluster.committers.items():
+            assert len(who) == 1, (
+                f"voted seed {seed}: term {term} committers {sorted(who)}")
+        assert not cluster.tick_errors, (
+            f"voted seed {seed}: tick loop raised: {cluster.tick_errors}")
+    finally:
+        cluster.close()
+
+
+@pytest.mark.parametrize("batch", range(5))
+def test_voted_adversarial_schedules(tmp_path, batch):
+    """5 x 8 = 40 randomized voted-election histories (the metadata
+    group's protocol: campaigns, vote restrictions, no-op commit
+    carriers) under drops/delays/duplication/leader isolation."""
+    for i in range(8):
+        seed = 5000 + batch * 100 + i
+        _run_voted_schedule(tmp_path / f"v{seed}", seed)

@@ -868,6 +868,17 @@ class PSServer:
 
     def _do_search(self, eng, body, vectors, ctx=None) -> dict:
         trace = {} if body.get("trace") else None
+        columnar = bool(
+            body.get("columnar_wire") and body.get("include_fields") == []
+        )
+        # raw_results skips the microbatcher, so only take the columnar
+        # engine shape when the batch is big enough that per-item
+        # shaping (not coalescing) is the cost that matters — small
+        # concurrent queries keep micro-batching (review r5)
+        first = next(iter(vectors.values())) if vectors else None
+        rows = (first.shape[0] if first is not None and first.ndim > 1
+                else 1)  # router ships [b, d]; a flat array is one query
+        raw = columnar and rows >= 32
         req = SearchRequest(
             vectors=vectors,
             k=int(body.get("k", 10)),
@@ -880,27 +891,39 @@ class PSServer:
                 f: tuple(b) for f, b in body["score_bounds"].items()
             } if body.get("score_bounds") else None,
             sort=body.get("sort") or None,
+            # columnar wire consumes the engine's columnar shape
+            # directly — no per-item objects anywhere on the path
+            raw_results=raw,
             trace=trace,
             ctx=ctx,
         )
         results = eng.search(req)
         metric = eng.indexes[next(iter(vectors))].metric.value
-        if body.get("columnar_wire") and body.get("include_fields") == []:
+        if columnar:
+            from vearch_tpu.engine.types import ColumnarSearchResults
+
             # fields-free searches ride columnar: keys as string lists,
             # scores as ONE ndarray over the binary tensor codec —
             # per-item JSON dicts for b=1024*k results were a measured
             # chunk of the e2e batch latency
-            out = {
-                "metric": metric,
-                "columnar": True,
-                "keys": [[it.key for it in r.items] for r in results],
-                # ONE flat score buffer (+ per-query lengths) — a tensor
-                # frame per query would pay the codec header 1024 times
-                "scores": np.asarray(
-                    [it.score for r in results for it in r.items],
-                    dtype=np.float32,
-                ),
-            }
+            if isinstance(results, ColumnarSearchResults):
+                out = {
+                    "metric": metric,
+                    "columnar": True,
+                    "keys": results.keys,
+                    "scores": np.asarray(results.scores, dtype=np.float32),
+                }
+            else:
+                # engine fell back to the item shape (e.g. sort rode in)
+                out = {
+                    "metric": metric,
+                    "columnar": True,
+                    "keys": [[it.key for it in r.items] for r in results],
+                    "scores": np.asarray(
+                        [it.score for r in results for it in r.items],
+                        dtype=np.float32,
+                    ),
+                }
         else:
             out = {
                 "metric": metric,

@@ -71,6 +71,10 @@ class SearchRequest:
     # returned ordered by them (reference: SortFields on the request,
     # doc_query.go:1543; sortorder value compare)
     sort: list[dict] | None = None
+    # fields-free fast path: return ColumnarSearchResults (key lists +
+    # one flat score buffer) instead of per-item objects — the serving
+    # shape of the columnar wire; skips the microbatcher
+    raw_results: bool = False
     # when not None, the engine records per-phase wall times into it
     # (reference: per-request trace:true timing breakdown,
     # client/client.go:521-565 + PerfTool, index_model.h:24)
@@ -760,6 +764,7 @@ class Engine:
             self.micro_batch
             and req.filters is None
             and not req.brute_force
+            and not req.raw_results
             and req.vectors
         ):
             mb = self._microbatcher
@@ -967,6 +972,21 @@ class Engine:
                 ok &= metric_scores <= min(his)
         flat_ids = ids[ok].astype(np.int64)
         keys = self.table.keys_for(flat_ids)
+        if req.raw_results and not req.sort and not want_fields:
+            # columnar serving shape: no per-item objects, scores stay
+            # one numpy buffer end to end
+            from vearch_tpu.engine.types import ColumnarSearchResults
+
+            counts = ok.sum(axis=1).tolist()
+            out_keys, pos = [], 0
+            for c in counts:
+                out_keys.append(keys[pos:pos + c])
+                pos += c
+            return ColumnarSearchResults(
+                keys=out_keys,
+                scores=np.ascontiguousarray(metric_scores[ok],
+                                            dtype=np.float32),
+            )
         fields_list = (
             self.table.gather_rows(flat_ids, req.include_fields)
             if want_fields

@@ -194,3 +194,16 @@ class SearchResult:
     """Per-query result list (reference: api_data/response.h:56 `Response`)."""
 
     items: list[SearchResultItem] = field(default_factory=list)
+
+
+@dataclass
+class ColumnarSearchResults:
+    """Fields-free search results in columnar form: per-query key lists
+    plus ONE flat score buffer (per-query lengths are the key-list
+    lengths). Returned by Engine.search for `raw_results` requests —
+    building b*k SearchResultItem objects measured ~50 ms of host time
+    at b=1024, which a TPU-speed kernel cannot hide; the PS columnar
+    wire path consumes this shape directly."""
+
+    keys: list[list[str]]
+    scores: Any  # np.ndarray [sum(len(keys_i))] f32
