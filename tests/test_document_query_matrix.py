@@ -203,3 +203,73 @@ def test_query_raft_consistent_read(qc):
     # replica it simply serves (client.go:1316-1360)
     out = _query(c, {"document_ids": ["k010"], "raft_consistent": True})
     assert out["total"] == 1
+
+
+# -- search bad-case matrix (reference: test_document_search.py
+#    TestDocumentSearchBadCase :664-681, cited per case) ---------------------
+
+def _search(c, body):
+    return rpc.call(c.router.addr, "POST", "/document/search",
+                    {"db_name": "db", "space_name": "s", **body})
+
+
+def test_search_wrong_db_space(qc):
+    c, _ = qc
+    # [0, "wrong_db"], [1, "wrong_space"]
+    v = [0.0] * D
+    for db, sp in (("nope", "s"), ("db", "nope")):
+        with pytest.raises(RpcError, match="not found"):
+            rpc.call(c.router.addr, "POST", "/document/search",
+                     {"db_name": db, "space_name": sp,
+                      "vectors": [{"field": "emb", "feature": v}]})
+
+
+def test_search_wrong_vector_shapes(qc):
+    c, _ = qc
+    # [5, "wrong_vector_length"]: not a multiple of the dimension
+    with pytest.raises(RpcError, match="dimension"):
+        _search(c, {"vectors": [{"field": "emb",
+                                 "feature": [0.0] * (D + 1)}]})
+    # [6, "wrong_vector_name"]: unknown vector field
+    with pytest.raises(RpcError):
+        _search(c, {"vectors": [{"field": "ghost",
+                                 "feature": [0.0] * D}]})
+    # [7, "wrong_vector_type"]: non-numeric feature payload
+    with pytest.raises(RpcError):
+        _search(c, {"vectors": [{"field": "emb",
+                                 "feature": ["x"] * D}]})
+    # [8, "empty_query"] / [9, "empty_vector"]
+    with pytest.raises(RpcError):
+        _search(c, {"vectors": []})
+    with pytest.raises(RpcError):
+        _search(c, {"vectors": [{"field": "emb", "feature": []}]})
+
+
+def test_search_wrong_filters(qc):
+    c, _ = qc
+    v = [0.0] * D
+    # [2/3, "wrong_range/term_filter"]: range operator on a string field
+    with pytest.raises(RpcError):
+        _search(c, {"vectors": [{"field": "emb", "feature": v}],
+                    "filters": {"operator": "AND", "conditions": [
+                        {"field": "name", "operator": ">=", "value": 1}]}})
+    # [10/11, "wrong_*_filter_name"]: unknown filter field
+    with pytest.raises(RpcError):
+        _search(c, {"vectors": [{"field": "emb", "feature": v}],
+                    "filters": {"operator": "AND", "conditions": [
+                        {"field": "ghost", "operator": "=", "value": 1}]}})
+
+
+def test_search_batch_and_limits(qc):
+    c, _ = qc
+    # positive control alongside the matrix: 3-query batch, k bound by
+    # corpus, every row sorted by metric score
+    rng = np.random.default_rng(3)
+    flat = rng.standard_normal(3 * D).astype(np.float32).tolist()
+    out = _search(c, {"vectors": [{"field": "emb", "feature": flat}],
+                      "limit": 7})
+    rows = out["documents"]
+    assert len(rows) == 3 and all(len(r) == 7 for r in rows)
+    for r in rows:
+        scores = [h["_score"] for h in r]
+        assert scores == sorted(scores)

@@ -595,6 +595,12 @@ class RouterServer:
                     f"dimension {wd}",
                 )
             b = feat.shape[0] // wd
+            if b == 0:
+                # an empty feature would trace a 0-query batch through
+                # the engine and answer [] — the reference 400s it
+                # (test_document_search.py badcase "empty_vector")
+                raise RpcError(400, f"empty feature for field "
+                                    f"{v['field']!r}")
             if nq is None:
                 nq = b
             elif nq != b:
