@@ -52,7 +52,8 @@ def _mk_node(tmp_path, nid, members, registry, **kw):
         return True
 
     def snapshot_fn():
-        return json.dumps(state["ops"]).encode(), node.applied
+        with node._apply_lock:  # see test_raft_adversarial snapshot_fn
+            return json.dumps(state["ops"]).encode(), node.applied
 
     def install_fn(data, _idx):
         state["ops"][:] = json.loads(data.decode())

@@ -127,7 +127,15 @@ class Cluster:
             return True
 
         def snapshot_fn():
-            return json.dumps(ops).encode(), node.applied
+            # capture atomically w.r.t. applies (the product paths do
+            # the same under _apply_lock): serializing ops and then
+            # reading node.applied separately let a concurrent apply
+            # land in between, producing a snapshot that CLAIMS an
+            # applied index it does not contain — installed followers
+            # then permanently miss one op (the full-suite 'ACKED op
+            # lost' flake)
+            with node._apply_lock:
+                return json.dumps(ops).encode(), node.applied
 
         def install_fn(data, _idx):
             ops[:] = json.loads(data.decode())
@@ -364,7 +372,15 @@ class VotedCluster:
             return True
 
         def snapshot_fn():
-            return json.dumps(ops).encode(), node.applied
+            # capture atomically w.r.t. applies (the product paths do
+            # the same under _apply_lock): serializing ops and then
+            # reading node.applied separately let a concurrent apply
+            # land in between, producing a snapshot that CLAIMS an
+            # applied index it does not contain — installed followers
+            # then permanently miss one op (the full-suite 'ACKED op
+            # lost' flake)
+            with node._apply_lock:
+                return json.dumps(ops).encode(), node.applied
 
         def install_fn(data, _idx):
             ops[:] = json.loads(data.decode())

@@ -643,6 +643,19 @@ class RaftNode:
         snap_index = int(body["snap_index"])
         snap_term = body.get("snap_term")
         with self._apply_lock:
+            with self._lock:
+                if snap_index <= self.applied:
+                    # stale stream (raft: ignore InstallSnapshot at or
+                    # below our applied index): a delayed/duplicated
+                    # same-term snapshot must not REWIND a follower that
+                    # already advanced past it via appends — the rewind
+                    # transiently un-applies committed entries (caught
+                    # by the adversarial suite as a vanished acked op).
+                    # success=True so the leader stops re-streaming; its
+                    # next append probe resynchronizes next_index.
+                    return {"success": True, "term": self.term,
+                            "last_index": self.wal.last_index,
+                            "stale": True}
             if self.install_fn is not None:
                 self.install_fn(bytes(buf), snap_index)
             with self._lock:
