@@ -42,13 +42,28 @@ def _metric_name(batch: int) -> str:
 
 
 def _emit_error(msg: str) -> None:
-    print(json.dumps({
+    rec = {
         "metric": _metric_name(64 if _capacity_mode() else 1024),
         "value": 0,
         "unit": "qps",
         "vs_baseline": 0,
         "error": msg,
-    }))
+    }
+    # even a dead-tunnel run records the roofline denominator the next
+    # capture will be judged against (perf_model is pure arithmetic —
+    # no device access)
+    try:
+        from vearch_tpu.ops.perf_model import peak_int8_ops, roofline_qps
+
+        n, d = (16_000_000, 128) if _capacity_mode() else (1_000_000, 128)
+        chip, peak = peak_int8_ops(None)
+        rec["roofline"] = {
+            "chip": chip,
+            "roofline_qps": round(roofline_qps(n, d, peak, rerank_r=128), 1),
+        }
+    except Exception:
+        pass
+    print(json.dumps(rec))
 
 
 def _require_device(attempts: int = 3, timeout_s: float = 180.0,
@@ -208,6 +223,43 @@ def _dryrun() -> bool:
     )
 
 
+# --- resumability ------------------------------------------------------------
+# Every tunnel death so far (r02-r05) threw away the ~109s ingest+build
+# before the first query ran. The trained engine + query set persist
+# under VEARCH_BENCH_CACHE (default ./.bench_cache) so a retry reloads
+# them (training skipped; raw vectors are re-absorbed), and every phase
+# appends a partial-result line to disk the moment it completes — a run
+# that dies mid-way still leaves per-phase numbers behind.
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "VEARCH_BENCH_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_cache"),
+    )
+
+
+def _phase_emitter(cache_key: str):
+    """(emit, path): emit(phase, **kv) prints one JSON line to stderr
+    AND appends it to the partials file, so partial results survive a
+    mid-run tunnel death."""
+    os.makedirs(_cache_dir(), exist_ok=True)
+    path = os.path.join(_cache_dir(), f"partial_{cache_key}.jsonl")
+
+    def emit(phase: str, **kv):
+        rec = {"phase": phase, "t_s": round(time.time(), 2), **kv}
+        line = json.dumps(rec)
+        print(line, file=sys.stderr, flush=True)
+        try:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass  # partials are best-effort; never kill the bench
+
+    return emit, path
+
+
 def main():
     if _dryrun():
         import jax as _jax
@@ -225,6 +277,8 @@ def main():
     )
     from vearch_tpu.ops.distance import brute_force_search
 
+    from vearch_tpu.utils import enable_compilation_cache
+
     n, d, batch = 1_000_000, 128, 1024
     if _dryrun():
         n, d, batch = 30_000, 32, 64
@@ -234,7 +288,16 @@ def main():
         # mirror is 2GB. The query batch shrinks so the [B, N] score
         # matrix stays inside HBM (b=64 -> 4GB f32).
         n, batch = (50_000, 16) if _dryrun() else (16_000_000, 64)
-    base, queries = build_data(n, d)
+
+    cache_key = (f"{'cap' if capacity else 'std'}"
+                 f"{'_dry' if _dryrun() else ''}_n{n}_d{d}")
+    emit, partial_path = _phase_emitter(cache_key)
+    # compiled XLA programs also persist across invocations, so a retry
+    # skips the compile stalls on top of the build
+    enable_compilation_cache(os.path.join(_cache_dir(), "xla_cache"))
+    engine_dir = os.path.join(_cache_dir(), f"engine_{cache_key}")
+    queries_npz = os.path.join(_cache_dir(), f"queries_{cache_key}.npz")
+    emit("start", cache_key=cache_key, partials=partial_path)
 
     params = {
         "ncentroids": 2048, "nsubvector": 32,
@@ -243,23 +306,47 @@ def main():
     }
     if _dryrun():
         params.update(ncentroids=128, nsubvector=16, train_iters=4)
-    schema = TableSchema("bench", [
-        FieldSchema("emb", DataType.VECTOR, dimension=d,
-                    index=IndexParams("IVFPQ", MetricType.L2, params)),
-    ])
-    eng = Engine(schema)
-    t0 = time.time()
-    step = 100_000
-    for i in range(0, n, step):
-        hi = min(i + step, n)
-        eng.upsert([{"_id": f"d{j}", "emb": base[j]} for j in range(i, hi)])
-        print(f"ingest {hi}/{n} {time.time()-t0:.0f}s",
-              file=sys.stderr, flush=True)
-    t_ingest = time.time() - t0
-    t0 = time.time()
-    eng.build_index()
-    t_build = time.time() - t0
-    print(f"build done {t_build:.0f}s", file=sys.stderr, flush=True)
+
+    resumed = (os.path.exists(os.path.join(engine_dir, "engine.json"))
+               and os.path.exists(queries_npz))
+    t_ingest = t_build = 0.0
+    if resumed:
+        # tunnel-retry path: reload the trained engine (training is
+        # skipped — raw vectors re-absorb through the persisted
+        # centroids/codebooks) instead of paying the ~109s build again
+        t0 = time.time()
+        eng = Engine.open(engine_dir)
+        eng.build_index()  # absorb-only: indexes are already trained
+        queries = np.load(queries_npz)["queries"]
+        emit("load_cached_index", dir=engine_dir,
+             load_s=round(time.time() - t0, 1), n=eng.doc_count)
+    else:
+        base, queries = build_data(n, d)
+        schema = TableSchema("bench", [
+            FieldSchema("emb", DataType.VECTOR, dimension=d,
+                        index=IndexParams("IVFPQ", MetricType.L2, params)),
+        ])
+        eng = Engine(schema)
+        t0 = time.time()
+        step = 100_000
+        for i in range(0, n, step):
+            hi = min(i + step, n)
+            eng.upsert([{"_id": f"d{j}", "emb": base[j]}
+                        for j in range(i, hi)])
+            print(f"ingest {hi}/{n} {time.time()-t0:.0f}s",
+                  file=sys.stderr, flush=True)
+        t_ingest = time.time() - t0
+        emit("ingest", seconds=round(t_ingest, 1), n=n, d=d)
+        t0 = time.time()
+        eng.build_index()
+        t_build = time.time() - t0
+        emit("build", seconds=round(t_build, 1))
+        try:
+            np.savez_compressed(queries_npz, queries=queries)
+            eng.dump(engine_dir)
+            emit("persist_index", dir=engine_dir)
+        except Exception as e:  # caching is best-effort
+            emit("persist_index_failed", error=f"{type(e).__name__}: {e}")
 
     idx = eng.indexes["emb"]
     # raw_results: the columnar serving shape (what the PS wire path
@@ -276,6 +363,28 @@ def main():
     dt = (time.time() - t0) / iters
     qps = batch / dt
 
+    # -- roofline denominator: theoretical int8-MXU QPS for this scan
+    # shape, so the capture reads "X% of roofline" instead of a bare
+    # QPS. Printed even with no TPU (chip falls back to an assumed
+    # label) so the denominator is always on record.
+    from vearch_tpu.ops import perf_model
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = None
+    chip, peak = perf_model.peak_int8_ops(kind)
+    rdepth_cfg = 128
+    roof = perf_model.roofline_qps(n, d, peak, rerank_r=rdepth_cfg)
+    roofline_diag = {
+        "chip": chip,
+        "peak_int8_ops": peak,
+        "roofline_qps": round(roof, 1),
+        "achieved_qps": round(qps, 1),
+        "frac_of_roofline": round(qps / roof, 4) if roof else 0.0,
+    }
+    emit("qps", batch=batch, qps=round(qps, 1), **roofline_diag)
+
     # single-query and small-batch latency (engine e2e, min of runs —
     # the axon tunnel adds tens of ms of per-call jitter)
     lat = {}
@@ -290,6 +399,8 @@ def main():
             eng.search(req_b)
             times.append(time.time() - t0)
         lat[b] = min(times)
+    emit("latency", ms_b1=round(lat[1] * 1e3, 1),
+         ms_b32=round(lat[32] * 1e3, 1))
 
     # -- per-phase breakdown (r4 review next-1: the captured headline
     # must be decomposable — where does the wall time go?) ------------
@@ -351,6 +462,7 @@ def main():
         "kernel_frac_of_e2e": round(t_fused / dt, 3) if dt else 0.0,
         "dispatches_per_search": 1,
     }
+    emit("phase_breakdown", **phase_ms)
 
     # recall gate vs exact bf16 scan on device
     buf, sqn, _ = store.device_buffer()
@@ -363,6 +475,7 @@ def main():
     recall = float(np.mean([
         len(got[q] & set(bi[q].tolist())) / 10 for q in range(batch)
     ]))
+    emit("recall", recall_at_10=round(recall, 4))
 
     # -- Glove-like COSINE regime (r4 review missing-6: the bench never
     # folded in an angular regime; real Glove is unreachable at zero
@@ -409,7 +522,9 @@ def main():
     except Exception as e:  # the angular block must never kill the
         glove_diag = {"glove_like_cosine": {"error": str(e)}}  # headline
 
+    emit("glove", **glove_diag.get("glove_like_cosine", {}))
     cpu_qps, cpu_diag = cpu_ivfpq_qps(idx, queries)
+    emit("cpu_baseline", **cpu_diag)
     result = {
         "metric": _metric_name(batch),
         "value": round(qps, 1),
@@ -424,6 +539,7 @@ def main():
     diag = {
         "recall_at_10": round(recall, 4),
         "phase_ms": phase_ms,
+        "roofline": roofline_diag,
         **glove_diag,
         **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),
@@ -431,6 +547,7 @@ def main():
         "latency_ms_b32": round(lat[32] * 1e3, 1),
         "ingest_s": round(t_ingest, 1),
         "build_s": round(t_build, 1),
+        "resumed_from_cache": resumed,
         "n": n, "d": d,
     }
     print(json.dumps(diag), file=sys.stderr)

@@ -1,0 +1,97 @@
+"""Round-6 ADVICE fixes (cluster layer).
+
+- joining an auth-enabled master group carries root credentials on
+  POST /members/add (previously an unhandled 401);
+- a stale InstallSnapshot ack no longer rewinds next_index below the
+  follower's real progress (the follower reports last_index; the leader
+  resyncs from there instead of re-sending entries it already has).
+"""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.raft import RaftNode
+
+
+def test_join_sends_root_auth(tmp_path, monkeypatch):
+    from vearch_tpu.cluster.master import MasterServer
+
+    calls = []
+
+    def fake_call(addr, method, path, body=None, timeout=120.0,
+                  auth=None, extra_headers=None):
+        calls.append((addr, method, path, body, auth))
+        if path == "/members/add":
+            return {"members": {"1": addr, "2": "127.0.0.1:9"}}
+        return {}
+
+    monkeypatch.setattr(rpc, "call", fake_call)
+    ms = MasterServer(
+        port=0,
+        node_id=2,
+        join="127.0.0.1:1",
+        meta_dir=str(tmp_path / "meta"),
+        persist_path=str(tmp_path / "meta.json"),
+        auth=True,
+        root_password="pw-join",
+        auto_recover=False,
+    )
+    try:
+        ms.start()
+    finally:
+        ms.stop()
+    joins = [c for c in calls if c[2] == "/members/add"]
+    assert joins, "joiner never registered with the existing group"
+    addr, method, _, body, auth = joins[0]
+    assert method == "POST" and body["node_id"] == 2
+    # the fix: credentials ride the join — /members/add is NOT in the
+    # target's auth-exempt group
+    assert auth == ("root", "pw-join")
+
+
+def _leader(tmp_path, send_fn, snap_index=5):
+    return RaftNode(
+        pid=1, node_id=1, wal_dir=str(tmp_path / "wal"),
+        apply_fn=lambda e: None, send_fn=send_fn,
+        members=[1, 2], is_leader=True,
+        snapshot_fn=lambda: (b"snapbytes", snap_index),
+    )
+
+
+def test_stale_snapshot_ack_respects_follower_progress(tmp_path):
+    """Follower already applied past snap_index (stale:true ack with its
+    last_index): next_index must resync from the REPORTED progress, not
+    rewind to snap_index+1 and re-send entries the follower has."""
+    def send_fn(peer, route, body):
+        assert route.endswith("/snapshot")
+        return {"success": True, "term": 1, "stale": True, "last_index": 9}
+
+    node = _leader(tmp_path, send_fn)
+    assert node._send_snapshot(2, term=0)
+    assert node._next[2] == 10
+    assert node._match[2] == 9
+    node.close()
+
+
+def test_stale_ack_never_rewinds_below_snapshot(tmp_path):
+    # degenerate stale ack (last_index below snap_index — e.g. a
+    # follower racing truncation): max() keeps the snapshot horizon
+    def send_fn(peer, route, body):
+        return {"success": True, "term": 1, "stale": True, "last_index": 3}
+
+    node = _leader(tmp_path, send_fn)
+    assert node._send_snapshot(2, term=0)
+    assert node._next[2] == 6
+    node.close()
+
+
+def test_fresh_snapshot_ack_unchanged(tmp_path):
+    def send_fn(peer, route, body):
+        return {"success": True, "term": 1}
+
+    node = _leader(tmp_path, send_fn)
+    assert node._send_snapshot(2, term=0)
+    assert node._next[2] == 6
+    assert node._match[2] == 5
+    node.close()

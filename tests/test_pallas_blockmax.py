@@ -134,6 +134,50 @@ def test_pallas_blockmax_non_tile_multiple_rows():
     assert int(np.asarray(ti)[0, 0]) == 2500
 
 
+def test_pallas_blockmax_d100_lane_pad():
+    """d=100 (glove regime) is not a 128 lane multiple: the kernel
+    inputs are zero-padded to d=128 before pallas_call so Mosaic can
+    compile on real TPU; results must be unchanged vs the XLA path
+    (ADVICE r5 low)."""
+    n, d = 2048, 100
+    q8, scale, vsq, base = _mirror_arrays(n=n, d=d, seed=17)
+    rng = np.random.default_rng(18)
+    queries = base[rng.choice(n, 5, replace=False)] + 0.01
+    valid = np.ones(n, dtype=bool)
+    for l2, metric in ((True, MetricType.L2),
+                       (False, MetricType.INNER_PRODUCT)):
+        xs, xi = ivf_ops.int8_scan_candidates(
+            jnp.asarray(queries), jnp.asarray(q8), jnp.asarray(scale),
+            jnp.asarray(vsq), jnp.asarray(valid), 16, metric, "blockmax")
+        ps, pi = int8_blockmax_scan_pallas(
+            jnp.asarray(queries), jnp.asarray(q8), jnp.asarray(scale),
+            jnp.asarray(vsq), jnp.asarray(valid), 16, l2)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(xi))
+        np.testing.assert_allclose(np.asarray(ps), np.asarray(xs),
+                                   rtol=1e-5, atol=1e-4)
+
+
+def test_pallas_blockmax_stage2_scan_multi_chunk():
+    """B=70 crosses the 32-query stage-2 chunk twice plus a padded
+    tail: the lax.scan chunk loop (one compiled body, not B/32 unrolled
+    copies) must return exactly what the XLA path returns for every
+    row, including the last partial chunk."""
+    q8, scale, vsq, base = _mirror_arrays(seed=19)
+    rng = np.random.default_rng(20)
+    queries = rng.standard_normal((70, D)).astype(np.float32)
+    valid = np.ones(N, dtype=bool)
+    xs, xi = ivf_ops.int8_scan_candidates(
+        jnp.asarray(queries), jnp.asarray(q8), jnp.asarray(scale),
+        jnp.asarray(vsq), jnp.asarray(valid), 48, MetricType.L2,
+        "blockmax")
+    ps, pi = int8_blockmax_scan_pallas(
+        jnp.asarray(queries), jnp.asarray(q8), jnp.asarray(scale),
+        jnp.asarray(vsq), jnp.asarray(valid), 48, True)
+    np.testing.assert_array_equal(np.asarray(pi), np.asarray(xi))
+    np.testing.assert_allclose(np.asarray(ps), np.asarray(xs),
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_pallas_blockmax_selection_actually_prunes():
     """N big enough that nb_sel < nblk (79 blocks vs 72 selected): the
     over-selection formula and stage-2 idx reconstruction are exercised

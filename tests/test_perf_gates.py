@@ -1,0 +1,241 @@
+"""Hardware-independent perf-regression gates (r6 tentpole).
+
+The only real TPU capture (BENCH_r01) was ~100x off the int8-MXU
+roofline and every bench since returned 0 because the tunnel was down —
+so every perf property the serving path claims is asserted HERE, on the
+CPU backend, the way recall is gated:
+
+- dispatch counts: each search path launches exactly its documented
+  number of device programs (ops/perf_model.py DOCUMENTED_DISPATCHES);
+- compiled-program stability: warmup pre-traces the configured batch
+  buckets, after which repeated same-shape searches add ZERO new
+  compiled programs (no silent retrace on the hot path);
+- bytes materialized: the block-max path's peak intermediate HBM is a
+  small fraction of the XLA full score matrix at serving shapes;
+- HBM footprint: the per-index capacity model tracks the real device
+  state the index publishes.
+"""
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType,
+    FieldSchema,
+    IndexParams,
+    MetricType,
+    TableSchema,
+)
+from vearch_tpu.ops import ivf as ivf_ops
+from vearch_tpu.ops import perf_model
+
+D = 32
+N = 3000
+
+
+def _build(index_type, params, n=N, warmup=None):
+    params = dict(params)
+    if warmup:
+        params["warmup_batches"] = warmup
+    schema = TableSchema("t", [
+        FieldSchema("group", DataType.INT),
+        FieldSchema("emb", DataType.VECTOR, dimension=D,
+                    index=IndexParams(index_type, MetricType.L2, params)),
+    ])
+    eng = Engine(schema)
+    rng = np.random.default_rng(33)
+    vecs = rng.standard_normal((n, D), dtype=np.float32)
+    eng.upsert([
+        {"_id": f"d{i:05d}", "group": i % 4, "emb": vecs[i]}
+        for i in range(n)
+    ])
+    eng.build_index()
+    eng.wait_for_index()
+    return eng, vecs
+
+
+IVFPQ_PARAMS = {
+    "ncentroids": 16, "nsubvector": 8, "train_iters": 4,
+    "training_threshold": 256,
+}
+
+
+@pytest.fixture(scope="module")
+def ivfpq_engine():
+    return _build("IVFPQ", IVFPQ_PARAMS, warmup=[8])
+
+
+def _search(eng, vecs, b=8, index_params=None):
+    """One engine search under a fresh PerfLedger; returns the ledger."""
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        eng.search(SearchRequest(
+            vectors={"emb": vecs[:b]}, k=10, include_fields=[],
+            index_params=index_params or {},
+        ))
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    return ledger
+
+
+# -- gate 1: dispatch count per search path ----------------------------------
+
+
+def test_ivfpq_paths_launch_documented_dispatches(ivfpq_engine):
+    eng, vecs = ivfpq_engine
+    doc = perf_model.DOCUMENTED_DISPATCHES
+    cases = {
+        "ivfpq_full_fused": {"scan_mode": "full"},
+        "ivfpq_full_unfused": {"scan_mode": "full", "fused_rerank": False},
+        "ivfpq_full_pallas": {"scan_mode": "full", "scan_kernel": "pallas"},
+        "ivfpq_probe": {"scan_mode": "probe"},
+    }
+    for path, params in cases.items():
+        ledger = _search(eng, vecs, index_params=params)
+        assert ledger.tags == doc[path], (
+            f"{path}: launched {ledger.tags}, documented {doc[path]} — "
+            "a new dispatch on a serving path must bump "
+            "DOCUMENTED_DISPATCHES in the same PR"
+        )
+
+
+def test_ivfflat_and_flat_dispatch_counts():
+    doc = perf_model.DOCUMENTED_DISPATCHES
+    eng, vecs = _build("IVFFLAT", {
+        "ncentroids": 16, "train_iters": 4, "training_threshold": 256,
+    })
+    assert _search(eng, vecs).tags == doc["ivfflat"]
+    feng, fvecs = _build("FLAT", {}, n=500)
+    assert _search(feng, fvecs).tags == doc["flat"]
+
+
+def test_ledger_per_search_aggregation(ivfpq_engine):
+    eng, vecs = ivfpq_engine
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        for _ in range(3):
+            eng.search(SearchRequest(
+                vectors={"emb": vecs[:8]}, k=10, include_fields=[],
+                index_params={"scan_mode": "full"}))
+            ledger.mark_search()
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    assert ledger.per_search() == [["fused_scan_rerank"]] * 3
+    assert ledger.dispatch_count() == 3
+    assert ledger.counts() == {"fused_scan_rerank": 3}
+
+
+# -- gate 2: compiled-program stability --------------------------------------
+
+
+def test_warmup_then_zero_new_programs(ivfpq_engine):
+    """build_index warmed b=8 (warmup_batches): the serving shapes are
+    already traced+compiled, so repeated b=8 searches add ZERO compiled
+    programs — the first real query never pays a compile stall."""
+    eng, vecs = ivfpq_engine
+    _search(eng, vecs, b=8)  # settle any first-use side programs
+    before = perf_model.total_compiled_programs()
+    for _ in range(3):
+        _search(eng, vecs, b=8)
+    after = perf_model.total_compiled_programs()
+    assert after == before, (
+        f"repeated same-shape searches grew the jit cache "
+        f"{before} -> {after}: something retraces per request"
+    )
+
+
+def test_compiled_program_counts_cover_registry():
+    counts = perf_model.compiled_program_counts()
+    # the serving entry points are registered and introspectable
+    # (-1 would mean jit internals moved under us)
+    for name in ("ivf.int8_scan_rerank", "ivf.ivfpq_candidates",
+                 "distance.brute_force_search"):
+        assert name in counts
+        assert counts[name] >= 0
+
+
+def test_explicit_warmup_pretraces_new_batch_size(ivfpq_engine):
+    eng, vecs = ivfpq_engine
+    done = eng.warmup(batches=[5])
+    assert done == {"emb": [5]}
+    before = perf_model.total_compiled_programs()
+    _search(eng, vecs, b=5)
+    assert perf_model.total_compiled_programs() == before
+
+
+# -- gate 3: bytes materialized ----------------------------------------------
+
+
+def test_blockmax_materializes_fraction_of_full_matrix():
+    """At the headline serving shape (1M x 128, b=1024, rerank 128) the
+    XLA path materializes a 4 GB [B, N] f32 score matrix; the block-max
+    path's peak intermediate HBM must stay under 5% of that. This is
+    the kernel's reason to exist, stated as a number."""
+    b, n_pad, d, r = 1024, 1_000_448, 128, 128
+    full = perf_model.scan_peak_bytes(b, n_pad, d, r, "xla_full")
+    blockmax = perf_model.scan_peak_bytes(b, n_pad, d, r, "pallas_blockmax")
+    assert full == b * n_pad * 4
+    assert blockmax < 0.05 * full
+    # both paths stream the mirror exactly once
+    assert (perf_model.scan_traffic_bytes(b, n_pad, d, "xla_full")
+            == perf_model.scan_traffic_bytes(b, n_pad, d,
+                                             "pallas_blockmax")
+            == n_pad * d)
+
+
+def test_blockmax_selection_matches_kernel_constants():
+    # mirrors ops/ivf.py _select_topk over-selection: nb_sel blocks of
+    # 512 rows, and never more blocks than exist
+    assert perf_model.blockmax_selected_blocks(128, 1_000_448) == 72
+    assert perf_model.blockmax_selected_blocks(128, 2048) == 4
+    with pytest.raises(ValueError):
+        perf_model.scan_peak_bytes(1, 512, 32, 8, "nope")
+
+
+# -- gate 4: HBM footprint model ---------------------------------------------
+
+
+def test_footprint_tracks_published_device_state():
+    # fresh engine: nothing probe-published yet, so the probe-state
+    # growth below is observable
+    eng, vecs = _build("IVFPQ", IVFPQ_PARAMS)
+    idx = eng.indexes["emb"]
+    store = eng.vector_stores["emb"]
+    fp = idx.device_footprint_bytes()
+    raw = perf_model.raw_store_footprint_bytes(
+        store.capacity, store.dimension, store.store_dtype.itemsize)
+    mirror = idx._mirror.device_bytes()
+    # the model covers at least the raw rerank buffer + the int8 mirror
+    assert fp >= raw + mirror
+    assert mirror == perf_model.mirror_footprint_bytes(
+        idx._mirror._h8.shape[0], D, "int8")
+    # probe publish adds the bucket tensors to the model
+    _search(eng, vecs, index_params={"scan_mode": "probe"})
+    assert idx.device_footprint_bytes() > fp
+
+
+def test_flat_footprint_is_store_only():
+    eng, _ = _build("FLAT", {}, n=500)
+    store = eng.vector_stores["emb"]
+    assert eng.indexes["emb"].device_footprint_bytes() == (
+        perf_model.raw_store_footprint_bytes(
+            store.capacity, store.dimension, store.store_dtype.itemsize))
+
+
+# -- roofline ----------------------------------------------------------------
+
+
+def test_roofline_math_and_chip_table():
+    # 1M x 128 int8 scan + 128-row rerank on an assumed v5e
+    label, peak = perf_model.peak_int8_ops(None)
+    assert "assumed" in label and peak == perf_model.INT8_PEAK_OPS["TPU v5e"]
+    label, peak = perf_model.peak_int8_ops("TPU v5 lite chip")
+    assert label == "TPU v5 lite" and "assumed" not in label
+    q = perf_model.roofline_qps(1_000_000, 128, 394.7e12, rerank_r=128)
+    # peak / (2*1e6*128 + 2*128*128) ~= 1.54M QPS
+    assert 1.5e6 < q < 1.6e6
+    # roofline scales down with N
+    assert perf_model.roofline_qps(2_000_000, 128, 394.7e12) < q

@@ -129,6 +129,10 @@ class MasterServer:
             # dead nodes expire through the normal reaper
             self._adopt_server_leases()
 
+        # kept for outbound member RPCs (join below): the target's
+        # /members/add is authenticated when auth is on
+        self._root_password = root_password
+
         def authenticator(headers, method, path):
             # per-endpoint privilege enforcement (reference:
             # cluster_api.go:153 role.HasPermissionForResources)
@@ -540,9 +544,14 @@ class MasterServer:
             # POST to the leader); the response carries the full member
             # map, and the leader starts replicating to us — catch-up
             # is ordinary log replay or a snapshot install
+            from vearch_tpu.cluster.auth import ROOT_NAME
+
+            # /members/add is NOT auth-exempt: joining an auth-enabled
+            # group without credentials dies with an unhandled 401
             out = rpc.call(self.join_addr, "POST", "/members/add",
                            {"node_id": self.node_id, "addr": self.addr},
-                           timeout=30.0)
+                           timeout=30.0,
+                           auth=(ROOT_NAME, self._root_password))
             with self._members_lock:
                 self.peers = {int(k): v for k, v in out["members"].items()}
                 with self.meta_node._lock:
@@ -1691,6 +1700,11 @@ class MasterServer:
         job_timeout = float(body.get("timeout_s", 3600.0))
 
         def worker():
+            # every job/partition mutation happens under
+            # _backup_jobs_lock so the deep-copying read path
+            # (_h_backup_jobs -> _deepcopy_job) sees a consistent
+            # record instead of relying on GIL timing; the lock is
+            # never held across an RPC — only around the dict writes
             shards_still_running = False
             try:
                 running = {}
@@ -1709,11 +1723,13 @@ class MasterServer:
                             ),
                             "job_id": sid,
                         })
-                        pj["status"] = "dumping"
+                        with self._backup_jobs_lock:
+                            pj["status"] = "dumping"
                         running[part.id] = (sid, srv)
                     except RpcError as e:
-                        pj["status"] = "error"
-                        pj["error"] = e.msg
+                        with self._backup_jobs_lock:
+                            pj["status"] = "error"
+                            pj["error"] = e.msg
                 deadline = time.time() + job_timeout
                 while running and time.time() < deadline:
                     # keep the space lock alive for the job's real
@@ -1730,39 +1746,43 @@ class MasterServer:
                                 f"/ps/backup/progress?job_id={sid}")
                         except RpcError:
                             continue  # transient; keep polling
-                        pj.update(
-                            status=st["status"],
-                            files_done=st.get("files_done", 0),
-                            files_total=st.get("files_total"),
-                        )
-                        if st["status"] == "done":
-                            job["results"].append(st.get("result"))
-                            del running[pid_]
-                        elif st["status"] == "error":
-                            pj["error"] = st.get("error")
-                            del running[pid_]
-                        job["updated"] = time.time()
+                        with self._backup_jobs_lock:
+                            pj.update(
+                                status=st["status"],
+                                files_done=st.get("files_done", 0),
+                                files_total=st.get("files_total"),
+                            )
+                            if st["status"] == "done":
+                                job["results"].append(st.get("result"))
+                                del running[pid_]
+                            elif st["status"] == "error":
+                                pj["error"] = st.get("error")
+                                del running[pid_]
+                            job["updated"] = time.time()
                     # CLI refreshes at 0.5s; polling much faster only
                     # burns RPCs (review r5)
                     time.sleep(0.25)
-                errs = [p for p in job["partitions"].values()
-                        if p["status"] == "error"]
-                if running:
-                    shards_still_running = True
-                    job["status"] = "error"
-                    job["error"] = "timed out waiting for shards " + str(
-                        sorted(running))
-                elif errs:
-                    job["status"] = "error"
-                    job["error"] = "; ".join(
-                        str(p.get("error")) for p in errs)
-                else:
-                    job["status"] = "done"
-                job["updated"] = time.time()
+                with self._backup_jobs_lock:
+                    errs = [p for p in job["partitions"].values()
+                            if p["status"] == "error"]
+                    if running:
+                        shards_still_running = True
+                        job["status"] = "error"
+                        job["error"] = (
+                            "timed out waiting for shards "
+                            + str(sorted(running)))
+                    elif errs:
+                        job["status"] = "error"
+                        job["error"] = "; ".join(
+                            str(p.get("error")) for p in errs)
+                    else:
+                        job["status"] = "done"
+                    job["updated"] = time.time()
             except Exception as e:  # job record must never stick "running"
-                job.update(status="error",
-                           error=f"{type(e).__name__}: {e}",
-                           updated=time.time())
+                with self._backup_jobs_lock:
+                    job.update(status="error",
+                               error=f"{type(e).__name__}: {e}",
+                               updated=time.time())
             finally:
                 if not shards_still_running:
                     self.store.unlock(lock_name, lock_owner)

@@ -333,9 +333,17 @@ class RaftNode:
                     return False
         except RpcError:
             return False
+        # a stale:true final chunk means the follower already advanced
+        # past snap_index via appends — rewinding next_index to
+        # snap_index+1 would re-send entries it already has (and its
+        # reported last_index is the real resync point)
+        peer_last = snap_index
+        if resp.get("stale"):
+            peer_last = max(snap_index, int(resp.get("last_index",
+                                                     snap_index)))
         with self._lock:
-            self._match[peer] = max(self._match.get(peer, 0), snap_index)
-            self._next[peer] = snap_index + 1
+            self._match[peer] = max(self._match.get(peer, 0), peer_last)
+            self._next[peer] = peer_last + 1
             self.snapshots_sent += 1
             self._advance_commit()
         return True

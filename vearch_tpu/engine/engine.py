@@ -123,6 +123,11 @@ class _FieldBuild:
 
 class Engine:
     def __init__(self, schema: TableSchema, data_dir: str | None = None):
+        from vearch_tpu.utils import enable_compilation_cache
+
+        # opt-in via VEARCH_COMPILE_CACHE: compiled search programs
+        # survive restarts, so warmup after a restart is a disk read
+        enable_compilation_cache()
         self.schema = schema
         self.data_dir = data_dir
         self.table = Table(schema)
@@ -546,6 +551,10 @@ class Engine:
         for name, params in (cfg.get("index_params") or {}).items():
             if name in self.indexes:
                 self.indexes[name].params.params.update(params)
+        if cfg.get("warmup"):
+            # re-trace after changing warmup_batches / index params at
+            # runtime without waiting for the next build
+            self.warmup()
         return {
             "refresh_interval_ms": self.schema.refresh_interval_ms,
             "training_threshold": self.schema.training_threshold,
@@ -726,6 +735,57 @@ class Engine:
             self.status = IndexStatus.UNINDEXED
             raise
         self.status = IndexStatus.INDEXED
+        # pre-trace the serving programs for the configured batch buckets
+        # now, at publish time, so the first real query never pays the
+        # compile stall (no-op unless "warmup_batches" is configured)
+        self.warmup(field_name=field_name)
+
+    def warmup(
+        self,
+        batches: list[int] | None = None,
+        k: int = 10,
+        field_name: str | None = None,
+    ) -> dict[str, list[int]]:
+        """Pre-trace + compile the jitted search programs for the given
+        query-batch sizes (default: each index's "warmup_batches" param).
+
+        Runs real searches through the serving path with stored rows as
+        queries, so the exact (shape, static-args) specialisations the
+        first requests would compile are already in the jit cache — and,
+        when the persistent compilation cache is enabled, on disk. The
+        perf gates assert the effect: after warmup, repeated same-shape
+        searches add ZERO new compiled programs. Returns the batch sizes
+        traced per field.
+        """
+        done: dict[str, list[int]] = {}
+        for name, index in self.indexes.items():
+            if field_name is not None and name != field_name:
+                continue
+            store = self.vector_stores[name]
+            if store.count == 0:
+                continue
+            b_list = batches if batches is not None else list(
+                index.params.get("warmup_batches", []) or []
+            )
+            if not b_list:
+                continue
+            # a live row, not zeros: cosine normalisation of an all-zero
+            # query would exercise a degenerate code path
+            row = np.asarray(store.host_view()[:1], dtype=np.float32)
+            valid = self._device_alive_mask(self.table.doc_count)
+            kk = max(1, min(int(k), store.count))
+            for b in sorted({int(x) for x in b_list if int(x) > 0}):
+                q = np.repeat(row, b, axis=0)
+                if index.trained:
+                    index.search(q, kk, valid)
+                else:
+                    from vearch_tpu.index.flat import FlatIndex
+
+                    FlatIndex(
+                        IndexParams(metric_type=index.metric), store
+                    ).search(q, kk, valid)
+                done.setdefault(name, []).append(b)
+        return done
 
     def rebuild_index(self) -> None:
         """Retrain from scratch (reference: engine.cc:1007 RebuildIndex)."""

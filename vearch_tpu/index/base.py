@@ -85,6 +85,19 @@ class VectorIndex(abc.ABC):
         directly just advance the counter."""
         self.indexed_count = upto
 
+    def device_footprint_bytes(self) -> int:
+        """Modeled resident HBM bytes of this index's device state
+        (ops/perf_model.py — the rows-per-chip capacity planner input).
+        Default covers indexes that search the raw store directly; index
+        types with extra device state (mirrors, bucket tensors) add it."""
+        from vearch_tpu.ops import perf_model
+
+        return perf_model.raw_store_footprint_bytes(
+            self.store.capacity,
+            self.store.dimension,
+            self.store.store_dtype.itemsize,
+        )
+
     # -- persistence (index-specific state only; raw vectors are dumped by
     #    the engine — reference: index is rebuildable, vectors are durable)
 

@@ -17,6 +17,7 @@ from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.engine.types import IndexParams
 from vearch_tpu.index.base import VectorIndex
 from vearch_tpu.index.registry import register_index
+from vearch_tpu.ops import ivf as ivf_ops
 from vearch_tpu.ops.distance import brute_force_search, to_device_mask
 
 
@@ -46,6 +47,7 @@ class FlatIndex(VectorIndex):
         base, base_sqnorm, n = self.store.device_buffer()
         cap = base.shape[0]
         mask = to_device_mask(valid_mask, n, cap)
+        ivf_ops.note_dispatch("flat_scan")
         scores, ids = brute_force_search(
             jnp.asarray(queries, dtype=base.dtype),
             base,

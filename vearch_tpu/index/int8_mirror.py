@@ -80,6 +80,13 @@ class Int8Mirror:
     def count(self) -> int:
         return self._n
 
+    def device_bytes(self) -> int:
+        """Modeled resident HBM bytes of the flushed mirror: compressed
+        rows + per-row scale + per-row ||v||^2, at the 512-aligned
+        capacity (ops/perf_model.py mirror_footprint_bytes)."""
+        cap = self._h8.shape[0]
+        return cap * self._row_width + 2 * cap * 4
+
     def append_quantized(
         self, q8: np.ndarray, scale: np.ndarray, vsq: np.ndarray,
         start: int | None = None,

@@ -66,6 +66,18 @@ class _IVFBase(VectorIndex):
         self._bucket_ids: jax.Array | None = None
         self._cap = 0
 
+    def _device_state_arrays(self) -> tuple:
+        """Device tensors this index keeps resident beyond the raw store
+        (footprint model input; subclasses extend)."""
+        return (self.centroids, self._bucket_ids)
+
+    def device_footprint_bytes(self) -> int:
+        total = super().device_footprint_bytes()
+        for a in self._device_state_arrays():
+            if a is not None:
+                total += int(a.size) * a.dtype.itemsize
+        return total
+
     # -- training ------------------------------------------------------------
 
     def _sample(self, x: np.ndarray) -> np.ndarray:
@@ -256,6 +268,11 @@ class IVFFlatIndex(_IVFBase):
         self._bucket_vecs: jax.Array | None = None
         self._bucket_sqnorm: jax.Array | None = None
 
+    def _device_state_arrays(self) -> tuple:
+        return super()._device_state_arrays() + (
+            self._bucket_vecs, self._bucket_sqnorm,
+        )
+
     def _publish(self) -> None:
         # under the absorb lock: a concurrent absorb would grow _members
         # between capacity sizing and the fill loop (found by the
@@ -295,6 +312,7 @@ class IVFFlatIndex(_IVFBase):
         )
         valid = self._valid_device(valid_mask, self.store.count)
         host_probes = self._host_probes(q, nprobe)
+        ivf_ops.note_dispatch("ivfflat_scan")
         scores, ids = ivf_ops.ivfflat_candidates(
             jnp.asarray(q, dtype=self.store.store_dtype),
             self.centroids,
@@ -370,6 +388,17 @@ class IVFPQIndex(_IVFBase):
         ).lower()
         self._mirror = Int8Mirror(store.dimension,
                                   storage=self.mirror_storage)
+
+    def _device_state_arrays(self) -> tuple:
+        return super()._device_state_arrays() + (
+            self.codebooks, self._bucket_resid8,
+            self._bucket_scale, self._bucket_vsq,
+        )
+
+    def device_footprint_bytes(self) -> int:
+        # bucket/centroid state + raw rerank store (super) + the
+        # docid-ordered compressed mirror the full-scan mode serves from
+        return super().device_footprint_bytes() + self._mirror.device_bytes()
 
     def _train_extra(self, sample: np.ndarray) -> None:
         assign = np.asarray(
@@ -614,6 +643,7 @@ class IVFPQIndex(_IVFBase):
                 # the pallas kernel selects probes in-kernel via scalar
                 # prefetch; host-graph selection rides the XLA path
                 kernel = "xla"
+            ivf_ops.note_dispatch("probe_scan")
             if kernel == "pallas":
                 from vearch_tpu.ops.pallas_kernels import (
                     ivfpq_probe_search_pallas,

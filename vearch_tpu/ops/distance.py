@@ -165,6 +165,15 @@ def brute_force_search(
     return masked_topk(scores, valid, k)
 
 
+# compiled-program tracking (ops/perf_model.py): lets the perf gates
+# assert the brute-force/FLAT path compiles once per shape
+from vearch_tpu.ops.perf_model import register_jit  # noqa: E402
+
+register_jit("distance.similarity_scores", similarity_scores)
+register_jit("distance.masked_topk", masked_topk)
+register_jit("distance.brute_force_search", brute_force_search)
+
+
 def merge_topk(
     scores_list: list[jax.Array],
     ids_list: list[jax.Array],

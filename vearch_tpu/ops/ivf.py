@@ -33,15 +33,18 @@ import jax.numpy as jnp
 
 from vearch_tpu.engine.types import MetricType
 from vearch_tpu.ops.distance import dot_precision, sqnorms
+from vearch_tpu.ops.perf_model import register_jit
 
 NEG_INF = float("-inf")
 
-# Optional dispatch ledger: when a list is installed here, index call
-# sites append one tag per device-program launch. Lets tests prove the
-# fused hot path really is ONE program where the unfused path is two
-# (r4 review next-1: each dispatch pays tunnel RTT + scheduling; the
-# CPU-backend trace test demonstrates the reduction when no TPU is
-# reachable).
+# Optional dispatch ledger: when a list (or ops/perf_model.PerfLedger)
+# is installed here, index call sites append one tag per device-program
+# launch. Lets tests prove the fused hot path really is ONE program
+# where the unfused path is two (r4 review next-1: each dispatch pays
+# tunnel RTT + scheduling; the CPU-backend trace test demonstrates the
+# reduction when no TPU is reachable). The perf-model layer
+# (ops/perf_model.py) aggregates these into the CI-asserted
+# DOCUMENTED_DISPATCHES gate.
 _dispatch_ledger: list | None = None
 
 
@@ -520,3 +523,20 @@ def int8_scan_rerank(
                      r, scan_metric, topk_mode)
     return exact_rerank(queries.astype(base.dtype), cand_i, base,
                         base_sqnorm, k, rerank_metric)
+
+
+# compiled-program tracking (ops/perf_model.py): every jitted search
+# entry point registers here so tests can assert that repeated
+# same-shape searches add ZERO new compiled programs — the retrace /
+# compile-stall regression gate
+for _name, _fn in (
+    ("ivf.ivfflat_candidates", ivfflat_candidates),
+    ("ivf.ivfpq_candidates", ivfpq_candidates),
+    ("ivf.int8_scan_candidates", int8_scan_candidates),
+    ("ivf.int4_scan_candidates", int4_scan_candidates),
+    ("ivf.cached_bucket_scan", cached_bucket_scan),
+    ("ivf.exact_rerank", exact_rerank),
+    ("ivf.exact_rerank_gathered", exact_rerank_gathered),
+    ("ivf.int8_scan_rerank", int8_scan_rerank),
+):
+    register_jit(_name, _fn)

@@ -224,6 +224,17 @@ def int8_blockmax_scan_pallas(
     if b_pad != b:
         qf = jnp.pad(qf, ((0, b_pad - b), (0, 0)))
     qsq = jnp.sum(qf * qf, axis=1)
+    # lane alignment: Mosaic requires the last block dim to be a
+    # 128-multiple on real TPU; d=100 (glove regime) would fail to
+    # compile. Zero-pad the feature dim for the KERNEL inputs only —
+    # zeros contribute nothing to the dots, and stage 2 gathers from
+    # the original unpadded mirror.
+    d_pad = -(-d // 128) * 128
+    qk = qf
+    a8k = approx8
+    if d_pad != d:
+        qk = jnp.pad(qf, ((0, 0), (0, d_pad - d)))
+        a8k = jnp.pad(approx8, ((0, 0), (0, d_pad - d)))
     # tn must DIVIDE n_pad or the grid truncates (rows past the last
     # full tile never scanned, their bmax columns uninitialized — review
     # r5). Mirror capacity is 512-aligned, so 512 always divides; prefer
@@ -241,8 +252,8 @@ def int8_blockmax_scan_pallas(
         functools.partial(_blockmax_kernel, l2=l2),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tb, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((tn, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((tb, d_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((tn, d_pad), lambda i, j: (j, 0)),
             pl.BlockSpec((tn,), lambda i, j: (j,)),
             pl.BlockSpec((tn,), lambda i, j: (j,)),
             pl.BlockSpec((tn,), lambda i, j: (j,)),
@@ -253,7 +264,7 @@ def int8_blockmax_scan_pallas(
         ),
         out_shape=jax.ShapeDtypeStruct((b_pad, nblk), jnp.float32),
         interpret=interp,
-    )(qf.astype(jnp.bfloat16), approx8, row_scale, row_vsq,
+    )(qk.astype(jnp.bfloat16), a8k, row_scale, row_vsq,
       valid.astype(jnp.int8), qsq)
     bmax = bmax[:b]
 
@@ -262,38 +273,58 @@ def int8_blockmax_scan_pallas(
     # HBM consumer (review r5 — at B=1024/r=128/d=128 an unchunked
     # gather is ~4.8 GB, defeating the kernel's memory win); 32-query
     # chunks bound it to ~150 MB while total traffic is unchanged. The
-    # kernel's sweet spot is small-to-mid batches — at very large B the
-    # XLA path's materialized score matrix amortizes better; that is
-    # exactly what the pallas_ab.py hardware A/B decides.
+    # chunk loop is a lax.scan, NOT an unrolled Python loop: unrolled,
+    # the program size and compile time grew linearly with batch
+    # (32 chunk bodies at B=1024 — VERDICT weak #7); the scan compiles
+    # ONE chunk body regardless of B. The kernel's sweet spot is
+    # small-to-mid batches — at very large B the XLA path's
+    # materialized score matrix amortizes better; that is exactly what
+    # the pallas_ab.py hardware A/B decides.
     r_eff = min(r, n_pad)
     nb_sel = max(32, r_eff // 4)
     nb_sel = min(2 * nb_sel + 8, nblk)
     _, top_blocks = jax.lax.top_k(bmax, nb_sel)  # [B, nb_sel]
     qsq_b = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1)
-    chunk = 32
-    outs_s, outs_i = [], []
-    for lo in range(0, b, chunk):
-        hi = min(lo + chunk, b)
-        tb_blocks = top_blocks[lo:hi]
-        idx = (tb_blocks[:, :, None] * _SCAN_BLOCK
-               + jnp.arange(_SCAN_BLOCK)[None, None, :]).reshape(
-                   hi - lo, nb_sel * _SCAN_BLOCK)
+    chunk = min(32, b)
+    rr = min(r_eff, nb_sel * _SCAN_BLOCK)
+    # pad B up to a chunk multiple: every row's math is independent
+    # (batched matvec + row-wise top_k), so the padded rows change
+    # nothing for real rows and are sliced off below
+    b2 = -(-b // chunk) * chunk
+    qs2 = queries.astype(jnp.float32)
+    if b2 != b:
+        qs2 = jnp.pad(qs2, ((0, b2 - b), (0, 0)))
+        qsq_b = jnp.pad(qsq_b, (0, b2 - b))
+        top_blocks = jnp.pad(top_blocks, ((0, b2 - b), (0, 0)))
+    offs = jnp.arange(_SCAN_BLOCK, dtype=top_blocks.dtype)
+
+    def _stage2(carry, inp):
+        q_c, qsq_c, blocks_c = inp  # [chunk, d], [chunk], [chunk, nb_sel]
+        idx = (blocks_c[:, :, None] * _SCAN_BLOCK
+               + offs[None, None, :]).reshape(
+                   chunk, nb_sel * _SCAN_BLOCK)
         vecs = approx8[idx]          # [chunk, S, d] int8
         dots = jax.lax.dot_general(
-            queries[lo:hi].astype(jnp.bfloat16), vecs.astype(jnp.bfloat16),
+            q_c.astype(jnp.bfloat16), vecs.astype(jnp.bfloat16),
             (((1,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )  # [chunk, S]
         dots = dots * row_scale[idx]
         if l2:
-            scores = -(qsq_b[lo:hi, None] - 2.0 * dots + row_vsq[idx])
+            scores = -(qsq_c[:, None] - 2.0 * dots + row_vsq[idx])
         else:
             scores = dots
         scores = jnp.where(valid[idx], scores, -jnp.inf)
-        rr = min(r_eff, scores.shape[1])
         top_s, pos = jax.lax.top_k(scores, rr)
-        outs_s.append(top_s)
-        outs_i.append(jnp.take_along_axis(idx, pos, axis=1))
-    top_s = jnp.concatenate(outs_s, axis=0)
-    ids = jnp.concatenate(outs_i, axis=0).astype(jnp.int32)
+        return carry, (top_s, jnp.take_along_axis(idx, pos, axis=1))
+
+    nchunks = b2 // chunk
+    _, (top_s, ids) = jax.lax.scan(
+        _stage2, None,
+        (qs2.reshape(nchunks, chunk, d),
+         qsq_b.reshape(nchunks, chunk),
+         top_blocks.reshape(nchunks, chunk, nb_sel)),
+    )
+    top_s = top_s.reshape(b2, rr)[:b]
+    ids = ids.reshape(b2, rr)[:b].astype(jnp.int32)
     return top_s, jnp.where(jnp.isfinite(top_s), ids, -1)

@@ -1,0 +1,259 @@
+"""Hardware-independent performance model + regression gates.
+
+The only real TPU capture so far (BENCH_r01) was ~100x off the int8-MXU
+roofline, dominated by dispatch and host overhead — and every capture
+since returned nothing because the TPU tunnel was down. This module
+makes the perf properties of the serving path *provable on the CPU
+backend*, the way recall is gated in CI: every dispatch-count win,
+compile-cache hit, and bytes-materialized saving is modeled here and
+asserted in tests/test_perf_gates.py, so a regression is caught before
+the one hardware run that counts.
+
+Four layers:
+
+1. `PerfLedger` — drop-in for the plain-list dispatch ledger
+   (ops/ivf.py set_dispatch_ledger): call sites append one tag per
+   device-program launch; the ledger aggregates per-search counts.
+2. jit registry — every jitted search entry point registers itself via
+   `register_jit`; `compiled_program_counts()` reads each function's
+   live jit-cache size, so a test can assert that repeated same-shape
+   searches add ZERO new compiled programs (no silent retrace).
+3. bytes-materialized model — peak intermediate HBM bytes per scan
+   path, mirroring the real kernel constants (ops/ivf.py BLOCK,
+   pallas_kernels chunking). The block-max path's whole reason to exist
+   is never materializing the [B, N] f32 score matrix; the model makes
+   that advantage a number tests can compare.
+4. HBM-footprint model — resident device bytes per index type
+   (index.device_footprint_bytes() feeds these helpers), the
+   rows-per-chip capacity planner.
+
+Everything here is arithmetic over shapes — no device access — so the
+gates run identically with and without a TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+# must match ops/ivf.py BLOCK and pallas_kernels._SCAN_BLOCK
+BLOCK = 512
+# stage-2 query chunk of the fused block-max kernel
+# (pallas_kernels int8_blockmax_scan_pallas)
+BLOCKMAX_STAGE2_CHUNK = 32
+
+F32 = 4
+I32 = 4
+
+
+# -- 1. dispatch ledger ------------------------------------------------------
+
+
+class PerfLedger:
+    """Dispatch ledger with per-search aggregation.
+
+    Compatible with the plain ``list`` contract of
+    ops/ivf.py ``set_dispatch_ledger`` (call sites only ever
+    ``append(tag)``); adds search boundaries and count summaries on top.
+    """
+
+    def __init__(self) -> None:
+        self.tags: list[str] = []
+        self._marks: list[int] = []
+
+    # list-compat surface used by note_dispatch call sites
+    def append(self, tag: str) -> None:
+        self.tags.append(tag)
+
+    def __iter__(self):
+        return iter(self.tags)
+
+    def __len__(self) -> int:
+        return len(self.tags)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, PerfLedger):
+            return self.tags == other.tags
+        return self.tags == other
+
+    def mark_search(self) -> None:
+        """Record a search boundary: tags appended after this call
+        belong to the next search."""
+        self._marks.append(len(self.tags))
+
+    def per_search(self) -> list[list[str]]:
+        """Tags grouped by the mark_search() boundaries."""
+        bounds = sorted({0, *self._marks, len(self.tags)})
+        return [self.tags[a:b] for a, b in zip(bounds, bounds[1:])]
+
+    def dispatch_count(self) -> int:
+        return len(self.tags)
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for t in self.tags:
+            out[t] = out.get(t, 0) + 1
+        return out
+
+
+#: documented device-program launches per engine-level search, by path.
+#: tests/test_perf_gates.py asserts the live ledger against this table;
+#: docs/PERF.md renders it. A new dispatch on a serving path MUST bump
+#: this table in the same PR — that is the regression gate.
+DOCUMENTED_DISPATCHES: dict[str, list[str]] = {
+    # IVFPQ full-scan, fused scan+rerank (default hot path): ONE program
+    "ivfpq_full_fused": ["fused_scan_rerank"],
+    # IVFPQ full-scan with fused_rerank=false (A/B escape hatch)
+    "ivfpq_full_unfused": ["scan", "rerank"],
+    # IVFPQ full-scan via the fused block-max pallas kernel
+    "ivfpq_full_pallas": ["pallas_blockmax_scan", "rerank"],
+    # IVFPQ probe mode: bucket scan + exact rerank
+    "ivfpq_probe": ["probe_scan", "rerank"],
+    # IVFFLAT probe scan (scores already exact — no rerank)
+    "ivfflat": ["ivfflat_scan"],
+    # FLAT exact scan: one fused matmul+topk program
+    "flat": ["flat_scan"],
+}
+
+
+# -- 2. compiled-program tracking -------------------------------------------
+
+_JIT_REGISTRY: dict[str, Any] = {}
+
+
+def register_jit(name: str, fn: Any) -> Any:
+    """Register a jitted search entry point for compile tracking.
+
+    Returns `fn` so modules can write
+    ``fn = register_jit("name", jax.jit(...))``.
+    """
+    _JIT_REGISTRY[name] = fn
+    return fn
+
+
+def compiled_program_counts() -> dict[str, int]:
+    """Live jit-cache entry count per registered search program.
+
+    Each entry is one (shape, static-args) specialisation XLA compiled.
+    Stable counts across repeated searches == no retrace on the hot
+    path; growth with every request is the compile-stall regression the
+    warmup + persistent-cache work exists to prevent.
+    """
+    out: dict[str, int] = {}
+    for name, fn in _JIT_REGISTRY.items():
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:
+            out[name] = -1  # jit internals moved; surface loudly
+    return out
+
+
+def total_compiled_programs() -> int:
+    return sum(max(v, 0) for v in compiled_program_counts().values())
+
+
+# -- 3. bytes-materialized model --------------------------------------------
+
+
+def blockmax_selected_blocks(r: int, n_pad: int) -> int:
+    """Candidate blocks stage 2 re-scores — mirrors the 2x+8
+    over-selection in ops/ivf.py _select_topk and the pallas kernel."""
+    nblk = max(n_pad // BLOCK, 1)
+    nb = max(32, min(r, n_pad) // 4)
+    return min(2 * nb + 8, nblk)
+
+
+def scan_peak_bytes(
+    b: int, n_pad: int, d: int, r: int, path: str
+) -> int:
+    """Peak intermediate HBM bytes one search materializes, per scan
+    path. This is PEAK (resident at once), not total traffic — the
+    chunked stage 2 deliberately trades re-gathers for a bounded
+    working set.
+
+    Paths:
+    - "xla_full": the default XLA scan materializes the [B, N] f32
+      score matrix (block-max selection then re-reads it).
+    - "pallas_blockmax": the fused kernel writes only [B, N/BLOCK] f32
+      block maxima; stage 2 holds one query-chunk's gathered blocks
+      (int8 rows + f32 scores + i32 ids).
+    """
+    if path == "xla_full":
+        return b * n_pad * F32
+    if path == "pallas_blockmax":
+        nblk = max(n_pad // BLOCK, 1)
+        nb_sel = blockmax_selected_blocks(r, n_pad)
+        s = nb_sel * BLOCK
+        chunk = min(BLOCKMAX_STAGE2_CHUNK, b)
+        bmax = b * nblk * F32
+        stage2 = chunk * s * (d + F32 + I32)  # int8 vecs + scores + ids
+        return bmax + stage2
+    raise ValueError(f"unknown scan path {path!r}")
+
+
+def scan_traffic_bytes(b: int, n_pad: int, d: int, path: str) -> int:
+    """HBM bytes READ by the stage-1 pass over the database — the
+    bandwidth-bound term of the roofline. int8 mirror rows dominate;
+    both paths read them exactly once."""
+    del b, path
+    return n_pad * d  # int8: one byte per dim
+
+
+# -- 4. HBM footprint model --------------------------------------------------
+
+
+def mirror_footprint_bytes(n_cap: int, d: int, storage: str = "int8") -> int:
+    """Resident device bytes of the docid-ordered compressed mirror:
+    rows + per-row scale + per-row ||v||^2 (index/int8_mirror.py)."""
+    width = d if storage == "int8" else (d + 1) // 2
+    return n_cap * width + 2 * n_cap * F32
+
+
+def raw_store_footprint_bytes(
+    capacity: int, d: int, itemsize: int
+) -> int:
+    """Raw device buffer + sqnorm column (engine/raw_vector.py)."""
+    return capacity * d * itemsize + capacity * F32
+
+
+def ivf_bucket_footprint_bytes(nlist: int, cap: int, d: int) -> int:
+    """Probe-mode IVFPQ device state: [nlist, cap, d] int8 residuals +
+    per-cluster scale + [nlist, cap] vsq + ids (index/ivf.py
+    _publish_locked)."""
+    return nlist * cap * d + nlist * F32 + 2 * nlist * cap * F32
+
+
+def roofline_qps(
+    n: int, d: int, peak_int8_ops: float, rerank_r: int = 0
+) -> float:
+    """Compute-roofline QPS for the int8 full scan: one [1, d] x [d, N]
+    int8 matmul per query (2 ops per MAC) plus the optional exact-rerank
+    matvec. The denominator bench.py prints so a capture reads "X% of
+    roofline" instead of a bare QPS."""
+    ops_per_query = 2.0 * n * d + 2.0 * rerank_r * d
+    return peak_int8_ops / max(ops_per_query, 1.0)
+
+
+#: per-chip peak int8 MXU throughput (ops/s). Public figures; the bench
+#: labels which row it used and falls back to DEFAULT_CHIP when no TPU
+#: is reachable so the denominator is always printed.
+INT8_PEAK_OPS: dict[str, float] = {
+    "TPU v4": 275e12,       # bf16 figure; v4 has no int8 doubling
+    "TPU v5 lite": 394.7e12,
+    "TPU v5e": 394.7e12,
+    "TPU v5": 918.8e12,     # v5p
+    "TPU v5p": 918.8e12,
+    "TPU v6 lite": 1836.0e12,  # trillium
+    "TPU v6e": 1836.0e12,
+}
+DEFAULT_CHIP = "TPU v5e"
+
+
+def peak_int8_ops(device_kind: str | None) -> tuple[str, float]:
+    """(label, ops/s) for a device kind; prefix-matches so platform
+    suffixes ("TPU v5 lite chip") still resolve. Unknown/absent kinds
+    fall back to DEFAULT_CHIP with an 'assumed' label."""
+    if device_kind:
+        for k in sorted(INT8_PEAK_OPS, key=len, reverse=True):
+            if device_kind.lower().startswith(k.lower()):
+                return k, INT8_PEAK_OPS[k]
+    return f"{DEFAULT_CHIP} (assumed)", INT8_PEAK_OPS[DEFAULT_CHIP]

@@ -23,6 +23,38 @@ def apply_jax_platform_env() -> None:
         pass
 
 
+_COMPILE_CACHE_DIR: str | None = None
+
+
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at `path` (default: the
+    VEARCH_COMPILE_CACHE env var; no-op when neither is set).
+
+    Compiled XLA programs survive process restarts, so a server restart
+    or a bench rerun skips the multi-second compile stall that engine
+    warmup otherwise pays once per process. Idempotent; returns the
+    active cache dir (or None when disabled).
+    """
+    global _COMPILE_CACHE_DIR
+    path = path or os.environ.get("VEARCH_COMPILE_CACHE")
+    if not path:
+        return _COMPILE_CACHE_DIR
+    if _COMPILE_CACHE_DIR == path:
+        return path
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.fspath(path))
+        # cache every program: warmup pre-traces small search programs
+        # whose compile time sits below the 1s default threshold
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        return None  # older jax without the persistent cache knobs
+    _COMPILE_CACHE_DIR = path
+    return path
+
+
 def prune_job_registry(jobs: dict, keep: int = 64) -> None:
     """Age out completed job records oldest-first, keeping `keep`
     finished entries (shared by the master and PS async-backup
