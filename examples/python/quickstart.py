@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Quickstart: standalone cluster, a space, some vectors, one profiled
+search with its phase breakdown printed.
+
+Runs entirely in-process (master + 2 PS + router threads) — no
+deployment needed:
+
+    JAX_PLATFORMS=cpu python examples/python/quickstart.py
+
+The profiled search at the end is the profile=true explain surface
+(docs/OBSERVABILITY.md): per-partition phase timings and the device
+dispatches the query actually launched, next to the perf model's
+prediction for the matched serving path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from vearch_tpu.cluster.standalone import StandaloneCluster  # noqa: E402
+from vearch_tpu.sdk.client import VearchClient  # noqa: E402
+
+D = 32
+N = 200
+
+
+def main() -> int:
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as data_dir:
+        cluster = StandaloneCluster(data_dir=data_dir, n_ps=2)
+        cluster.start()
+        try:
+            client = VearchClient(cluster.router_addr)
+            client.create_database("quickstart")
+            client.create_space("quickstart", {
+                "name": "articles",
+                "partition_num": 2,
+                "fields": [
+                    {"name": "topic", "data_type": "integer"},
+                    {"name": "embedding", "data_type": "vector",
+                     "dimension": D,
+                     "index": {"index_type": "FLAT",
+                               "metric_type": "L2", "params": {}}},
+                ],
+            })
+
+            vecs = rng.standard_normal((N, D)).astype(np.float32)
+            client.upsert("quickstart", "articles", [
+                {"_id": f"doc-{i}", "topic": i % 5, "embedding": vecs[i]}
+                for i in range(N)
+            ])
+            print(f"indexed {N} docs across 2 partitions")
+
+            # plain search: per-query hit lists
+            hits = client.search(
+                "quickstart", "articles",
+                [{"field": "embedding", "feature": vecs[42]}], limit=3)
+            print("top hit:", hits[0][0]["_id"],
+                  f"(score {hits[0][0]['_score']:.4f})")
+            assert hits[0][0]["_id"] == "doc-42"
+
+            # profiled search: documents + the explain breakdown
+            out = client.search(
+                "quickstart", "articles",
+                [{"field": "embedding", "feature": vecs[42]}],
+                limit=3, profile=True)
+            prof = out["profile"]
+            print(f"\nprofile ({prof['partition_count']} partitions, "
+                  f"router merge {prof['merge_ms']} ms):")
+            for pid, part in sorted(prof["partitions"].items()):
+                print(f"  partition {pid}: rpc {part['rpc_ms']} ms, "
+                      f"{part['doc_count']} docs")
+                for phase, ms in sorted(part["phases"].items()):
+                    print(f"    {phase:<14} {ms:8.3f} ms")
+                disp = part["dispatches"]
+                print(f"    dispatches    {disp['tags']} "
+                      f"(path={disp['path']}, "
+                      f"predicted={disp['predicted']})")
+                assert disp["tags"] == disp["predicted"]
+            print("\nquickstart OK")
+            return 0
+        finally:
+            cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Metrics-cardinality safety (observability satellite): a profiled
+soak must not grow the /metrics series set. Per-request observability
+rides spans and the profile payload — NEVER metric labels — so label
+sets stay bounded by topology (partition, peer, http route), not by
+traffic (docid, trace id, query).
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import vearch_tpu.cluster.rpc as rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+from tests.test_metrics_gauges import scrape
+
+D = 8
+N_QUERIES = 1000
+BATCH = 10  # queries per RPC: 100 RPCs x 10 vectors = the 1k soak
+
+
+def _series(text: str) -> set[str]:
+    """Every `name{labels}` sample key on the page (values stripped;
+    histogram bucket/sum/count lines included — a new bucket IS a new
+    series)."""
+    out = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][\w:]*(?:\{[^}]*\})?) ", line)
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2)
+    c.start()
+    yield c
+    c.stop()
+
+
+def test_profiled_soak_does_not_grow_series(cluster, rng):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((100, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(100)])
+
+    def profiled_batch(qs: np.ndarray) -> None:
+        out = rpc.call(cluster.router_addr, "POST", "/document/search", {
+            "db_name": "db", "space_name": "s",
+            "vectors": [{"field": "v", "feature": q.tolist()} for q in qs],
+            "limit": 5, "profile": True, "trace": True,
+        })
+        assert out["profile"]["partition_count"] == 2
+
+    addrs = [cluster.router_addr] + [ps.addr for ps in cluster.ps_nodes]
+
+    # warm every code path once so first-use series (http route labels,
+    # histogram label sets) exist before the baseline scrape
+    profiled_batch(vecs[:BATCH])
+    baseline = {a: _series(scrape(a)) for a in addrs}
+
+    done = BATCH
+    while done < N_QUERIES:
+        qs = vecs[rng.integers(0, 100, size=BATCH)]
+        profiled_batch(qs)
+        done += BATCH
+
+    for addr in addrs:
+        text = scrape(addr)
+        grown = _series(text) - baseline[addr]
+        # uptime/process gauges may appear lazily but per-REQUEST series
+        # must not: anything new would scale with traffic
+        assert not grown, f"{addr}: series grew during soak: {grown}"
+        # no per-request identifiers as label values anywhere
+        assert "trace_id=" not in text
+        assert "span_id=" not in text
+        assert 'peer="d' not in text  # docids never leak into peer
+        for line in text.splitlines():
+            assert not re.search(r'="d\d{1,3}"', line), line
+        # and the page stays small in absolute terms
+        assert len(_series(text)) < 600, addr

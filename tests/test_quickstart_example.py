@@ -1,0 +1,21 @@
+"""The shipped quickstart example must actually run (observability
+satellite: the profiled-search walkthrough is the first thing a new
+user executes — a broken example is a broken front door)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "python", "quickstart.py")
+
+
+def test_quickstart_runs_and_prints_profile():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, SCRIPT], capture_output=True,
+                         text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "top hit: doc-42" in out.stdout
+    assert "profile (2 partitions" in out.stdout
+    assert "dispatches    ['flat_scan']" in out.stdout
+    assert "quickstart OK" in out.stdout

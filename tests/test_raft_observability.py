@@ -1,0 +1,230 @@
+"""Raft replication observability: per-peer lag gauges, commit/apply
+latency, heartbeat-driven convergence, and the /metrics surface on a PS
+during an induced follower stall (observability tentpole + the
+adversarial-schedule liveness fix).
+
+Reference: the monitor package graphs raft write latency per partition
+(internal/monitor/monitor_service.go:77); per-peer next/match state is
+what the reference's `_cluster/health?detail=true` exposes per replica.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster.master import MasterServer
+from vearch_tpu.cluster.ps import PSServer
+from vearch_tpu.cluster.raft import RaftNode
+from vearch_tpu.cluster.router import RouterServer
+from vearch_tpu.cluster.rpc import RpcError
+from vearch_tpu.sdk.client import VearchClient
+
+from tests.test_metrics_gauges import gauge_value, scrape
+
+D = 8
+
+
+# -- direct-node harness (idiom: test_advice_r5._mk_node) --------------------
+
+def _mk_node(tmp_path, nid, members, registry, stalled, **kw):
+    state = {"ops": []}
+
+    def apply_fn(op):
+        state["ops"].append(op)
+        return True
+
+    def snapshot_fn():
+        with node._apply_lock:
+            return json.dumps(state["ops"]).encode(), node.applied
+
+    def install_fn(data, _idx):
+        state["ops"][:] = json.loads(data.decode())
+
+    def send_fn(peer, path, body):
+        if peer in stalled:
+            raise RpcError(503, f"node {peer} stalled")
+        target = registry[peer]
+        if path.endswith("/append"):
+            return target.handle_append(body)
+        if path.endswith("/snapshot"):
+            return target.handle_install_snapshot(body)
+        raise AssertionError(f"unexpected route {path}")
+
+    node = RaftNode(
+        pid=1, node_id=nid, wal_dir=str(tmp_path / f"n{nid}"),
+        apply_fn=apply_fn, send_fn=send_fn, members=members,
+        is_leader=False, snapshot_fn=snapshot_fn, install_fn=install_fn,
+        quorum_timeout=5.0, **kw,
+    )
+    node._test_state = state
+    registry[nid] = node
+    return node
+
+
+def test_lag_gauge_rises_and_tick_alone_converges(tmp_path):
+    """The liveness-flake root cause, proven with the new lag gauge: a
+    follower unreachable during proposes accumulates visible lag, and
+    the leader's heartbeat tick ALONE — no new proposals — re-probes it
+    to full convergence (entries AND commit index, so the follower
+    actually applies). Before the fix, a retry arriving while another
+    sync held the peer lock was silently dropped, and a sync exited as
+    soon as the peer held every entry even if its commit index (and
+    therefore its applied state) was stale."""
+    registry: dict[int, RaftNode] = {}
+    stalled: set[int] = set()
+    a = _mk_node(tmp_path, 1, [1, 2, 3], registry, stalled)
+    b = _mk_node(tmp_path, 2, [1, 2, 3], registry, stalled)
+    c = _mk_node(tmp_path, 3, [1, 2, 3], registry, stalled)
+    try:
+        a.become_leader(1, [1, 2, 3])
+        a.propose([{"seq": 0}])
+        assert a.replication_lag() == {2: 0, 3: 0}
+
+        stalled.add(3)
+        for i in range(1, 4):
+            a.propose([{"seq": i}])  # quorum via b; c misses everything
+        lag = a.replication_lag()
+        assert lag[2] == 0 and lag[3] == 3, lag
+        peers = a.state()["peers"]
+        assert peers["3"]["lag"] == 3
+        assert peers["3"]["match"] == 1
+        # the stalled peer's ack age is stale relative to the healthy one
+        assert peers["3"]["ack_age"] > peers["2"]["ack_age"]
+        assert a.heartbeat_age() == pytest.approx(
+            peers["3"]["ack_age"], abs=0.5)
+        assert c.applied == 1 and c._test_state["ops"] == [{"seq": 0}]
+
+        # heal; heartbeat ticks only — convergence must not need a write
+        stalled.discard(3)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            a.tick()
+            if a.replication_lag()[3] == 0 and c.applied == a.commit:
+                break
+            time.sleep(0.05)
+        assert a.replication_lag()[3] == 0
+        assert c.commit == a.commit and c.applied == a.commit
+        assert c._test_state["ops"] == a._test_state["ops"]
+        assert a.state()["peers"]["3"]["lag"] == 0
+    finally:
+        for n in registry.values():
+            n.close()
+
+
+def test_observer_events_fire_outside_protocol(tmp_path):
+    """The observer sink sees commit/apply latency and leadership
+    transitions, and a raising observer never breaks the protocol."""
+    registry: dict[int, RaftNode] = {}
+    stalled: set[int] = set()
+    events: list[tuple[str, dict]] = []
+
+    def observer(event, info):
+        events.append((event, dict(info)))
+        raise RuntimeError("observer bug")  # must be swallowed
+
+    a = _mk_node(tmp_path, 1, [1, 2], registry, stalled, observer=observer)
+    b = _mk_node(tmp_path, 2, [1, 2], registry, stalled)
+    try:
+        a.become_leader(1, [1, 2])
+        a.propose([{"seq": 1}, {"seq": 2}])
+        kinds = [e for e, _ in events]
+        assert "become_leader" in kinds
+        commits = [i for e, i in events if e == "commit"]
+        assert len(commits) == 1
+        assert commits[0]["entries"] == 2 and commits[0]["seconds"] >= 0
+        applies = [i for e, i in events if e == "apply"]
+        assert [i["index"] for i in applies] == [1, 2]
+        assert all(i["seconds"] >= 0 for i in applies)
+        # despite the raising observer, the write went through
+        assert a._test_state["ops"] == b._test_state["ops"] == [
+            {"seq": 1}, {"seq": 2}]
+        assert a.state()["elections_started"] == 0  # decreed, not voted
+    finally:
+        a.close()
+        b.close()
+
+
+# -- cluster surface: /metrics moves during an induced follower stall --------
+
+def test_ps_metrics_move_during_follower_stall(tmp_path, rng):
+    """Acceptance: the leader PS's /metrics exposes raft peer-lag and
+    commit-latency series, and an induced follower stall makes them
+    move — lag > 0 for the dead node, commit-latency histogram count
+    grows with each write, heartbeat age present. heartbeat_ttl is kept
+    long so the master does not reconfigure the group mid-assertion."""
+    master = MasterServer(heartbeat_ttl=30.0)
+    master.start()
+    ps_nodes = []
+    for i in range(3):
+        ps = PSServer(data_dir=str(tmp_path / f"ps{i}"),
+                      master_addr=master.addr, heartbeat_interval=0.3)
+        ps.start()
+        ps_nodes.append(ps)
+    router = RouterServer(master_addr=master.addr)
+    router.start()
+    try:
+        cl = VearchClient(router.addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 3,
+            "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                        "index": {"index_type": "FLAT", "metric_type": "L2",
+                                  "params": {}}}],
+        })
+        vecs = rng.standard_normal((30, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(10)])
+
+        part = cl.get_space("db", "s")["partitions"][0]
+        pid = part["id"]
+        leader_ps = next(p for p in ps_nodes
+                         if p.node_id == part["leader"])
+        follower = next(p for p in ps_nodes
+                        if p.node_id != part["leader"])
+
+        text = scrape(leader_ps.addr)
+        assert gauge_value(text, "vearch_raft_is_leader",
+                           partition=pid) == 1.0
+        assert (gauge_value(text, "vearch_raft_commit_index",
+                            partition=pid) or 0) > 0
+        # healthy group: every peer at lag 0
+        for p in ps_nodes:
+            if p.node_id != leader_ps.node_id:
+                assert gauge_value(text, "vearch_raft_peer_lag",
+                                   partition=pid, peer=p.node_id) == 0.0
+        c0 = gauge_value(
+            text, "vearch_raft_commit_latency_seconds_count",
+            partition=pid) or 0.0
+        assert c0 > 0  # the upserts above were timed
+        # exporter-health counters ride the same scrape (satellite:
+        # collector outage is observable, not silent)
+        assert "tracing_dropped_spans_total" in text
+
+        follower.stop()  # induced stall
+        cl.upsert("db", "s", [{"_id": f"s{i}", "v": vecs[10 + i]}
+                              for i in range(10)])
+
+        text = scrape(leader_ps.addr)
+        lag = gauge_value(text, "vearch_raft_peer_lag",
+                          partition=pid, peer=follower.node_id)
+        assert lag is not None and lag > 0, "stalled peer shows no lag"
+        nxt = gauge_value(text, "vearch_raft_peer_next_index",
+                          partition=pid, peer=follower.node_id)
+        assert nxt is not None
+        c1 = gauge_value(
+            text, "vearch_raft_commit_latency_seconds_count",
+            partition=pid) or 0.0
+        assert c1 > c0  # writes during the stall were still timed
+        age = gauge_value(text, "vearch_raft_heartbeat_age_seconds",
+                          partition=pid)
+        assert age is not None and age >= 0.0
+    finally:
+        router.stop()
+        for ps in ps_nodes:
+            try:
+                ps.stop()
+            except Exception:
+                pass
+        master.stop()

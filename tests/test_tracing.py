@@ -6,6 +6,7 @@ Here: span trees propagated via the RPC envelope, stored per-process,
 queryable on every role."""
 
 import json
+import time
 import urllib.request
 
 import numpy as np
@@ -110,6 +111,7 @@ class _MockCollector:
 
     def close(self):
         self.httpd.shutdown()
+        self.httpd.server_close()  # free the port: connects now refused
 
 
 def test_otlp_exporter_ships_span_tree(tmp_path, rng):
@@ -163,10 +165,16 @@ def test_otlp_exporter_ships_span_tree(tmp_path, rng):
             assert s["service"] == "router"
             assert s["parentSpanId"] == root["spanId"]
         scatter_ids = {s["spanId"] for s in scatter}
-        ps_spans = [s for s in got if s["service"] == "ps"]
-        assert len(ps_spans) == 2
-        for s in ps_spans:
-            assert s["parentSpanId"] in scatter_ids | {root["spanId"]}
+        ps_search = [s for s in got
+                     if s["service"] == "ps" and s["name"] == "ps.search"]
+        assert len(ps_search) == 2  # one per partition
+        ps_search_ids = {s["spanId"] for s in ps_search}
+        for s in (ss for ss in got if ss["service"] == "ps"):
+            if s["name"] == "ps.search":
+                assert s["parentSpanId"] in scatter_ids | {root["spanId"]}
+            else:
+                # engine/kernel phase spans nest under their ps.search
+                assert s["parentSpanId"] in ps_search_ids
             # OTLP shape essentials survive the wire
             assert len(s["traceId"]) == 32 and len(s["spanId"]) == 16
             assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
@@ -178,6 +186,37 @@ def test_otlp_exporter_ships_span_tree(tmp_path, rng):
         ps.stop()
         master.stop()
         col.close()
+
+
+def test_otlp_exporter_collector_killed_mid_batch():
+    """Collector outage mid-run: spans shipped before the kill count as
+    exported, spans after it count as dropped, and neither span creation
+    nor the ring store is affected. The request path must never pay for
+    collector health (observability satellite)."""
+    col = _MockCollector()
+    tr = Tracer("svc", collector_endpoint=col.endpoint)
+    with tr.span("before"):
+        pass
+    tr.exporter.flush()
+    assert tr.exporter.exported >= 1
+    assert tr.exporter.dropped == 0
+    assert any(s["name"] == "before" for s in col.spans())
+
+    col.close()  # collector dies with spans still being produced
+
+    t0 = time.monotonic()
+    for i in range(64):
+        with tr.span(f"after-{i}"):
+            pass
+    # creation is queue-append only — a dead collector adds no latency
+    assert time.monotonic() - t0 < 1.0
+    tr.exporter.flush()
+    assert tr.exporter.dropped >= 64
+    assert tr.exporter.exported >= 1  # pre-kill batch still counted
+    # local ring store keeps every span regardless of collector health
+    assert len(tr.spans()) == 65
+    # queue stays bounded: sustained outage evicts, never grows
+    assert len(tr.exporter._q) == 0
 
 
 def test_otlp_exporter_survives_dead_collector():
@@ -237,10 +276,12 @@ def test_cluster_span_propagation(tmp_path, rng):
                 assert s["parent_id"] == root["span_id"]
 
         p_spans = _fetch_traces(ps.addr, tid)
-        assert len(p_spans) == 2  # one ps.search per partition
+        searches = [s for s in p_spans if s["name"] == "ps.search"]
+        assert len(searches) == 2  # one ps.search per partition
         scatter_ids = {s["span_id"] for s in r_spans
                        if s["name"] == "router.scatter"}
-        for s in p_spans:
+        search_ids = {s["span_id"] for s in searches}
+        for s in searches:
             assert s["service"] == "ps"
             assert s["trace_id"] == tid
             # joined under the router's scatter spans... or directly the
@@ -249,8 +290,19 @@ def test_cluster_span_propagation(tmp_path, rng):
             assert s["parent_id"] in scatter_ids or (
                 s["parent_id"] == root["span_id"]
             )
-            # engine phase timings ride as tags
+            # engine phase timings ride as tags, prediction beside them
             assert any(k.endswith("_ms") for k in s["tags"])
+            assert s["tags"].get("predicted_dispatches") is not None
+        # per-phase engine + kernel child spans under each ps.search
+        # (observability tentpole: the search is no longer opaque)
+        child_names = {s["name"] for s in p_spans
+                       if s["parent_id"] in search_ids}
+        assert "ps.gate_wait" in child_names
+        assert any(n.startswith("engine.search.") for n in child_names)
+        assert any(n.startswith("kernel.") for n in child_names)
+        for s in p_spans:
+            if s["name"] not in ("ps.search",):
+                assert s["parent_id"] in search_ids
 
         # untraced searches produce no new spans
         before = len(_fetch_traces(router.addr, ""))

@@ -79,6 +79,16 @@ class CallbackGauge:
         return "\n".join(lines)
 
 
+class CallbackCounter(CallbackGauge):
+    """Counter sampled at scrape time from an existing monotonic source
+    (e.g. raft election totals, the OTLP exporter's dropped-span count)
+    — avoids double-bookkeeping a value the owner already maintains.
+    `fn` has the CallbackGauge contract: {label_values_tuple: value}."""
+
+    def render(self) -> str:
+        return super().render().replace(" gauge", " counter", 1)
+
+
 class Histogram:
     def __init__(
         self,
@@ -152,6 +162,12 @@ class Registry:
             self._metrics.append(m)
         return m
 
+    def callback_counter(self, name, help_, labels, fn) -> CallbackCounter:
+        m = CallbackCounter(name, help_, labels, fn)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
     def histogram(self, name, help_, labels=(), buckets=_DEFAULT_BUCKETS) -> Histogram:
         m = Histogram(name, help_, labels, buckets)
         with self._lock:
@@ -161,6 +177,28 @@ class Registry:
     def render(self) -> str:
         with self._lock:
             return "\n".join(m.render() for m in self._metrics) + "\n"
+
+
+def register_tracer_metrics(registry: "Registry", tracer) -> None:
+    """OTLP exporter health counters on every traced role: a dead or
+    slow collector costs dropped batches, never request latency — these
+    make that loss visible instead of silent. Zero when no collector is
+    configured (the exporter is absent)."""
+
+    def _read(attr: str):
+        def read() -> dict[tuple, float]:
+            exp = getattr(tracer, "exporter", None)
+            return {(): float(getattr(exp, attr, 0) or 0) if exp else 0.0}
+        return read
+
+    registry.callback_counter(
+        "tracing_dropped_spans_total",
+        "spans lost to queue overflow or a dead collector",
+        (), _read("dropped"))
+    registry.callback_counter(
+        "tracing_exported_spans_total",
+        "spans successfully shipped to the collector",
+        (), _read("exported"))
 
 
 def register_process_gauges(registry: "Registry") -> None:

@@ -28,6 +28,7 @@ from vearch_tpu.engine.engine import Engine, SearchRequest
 from vearch_tpu.engine.types import DataType, TableSchema
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Partition
+from vearch_tpu.cluster.metrics import register_tracer_metrics
 from vearch_tpu.cluster.raft import RaftNode
 from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
 from vearch_tpu.utils import log
@@ -38,6 +39,39 @@ _log = log.get("ps")
 # lagging follower catches up by replay instead of full snapshot
 # (reference: raft_truncate_count)
 WAL_KEEP_ENTRIES = 10_000
+
+
+def _profile_from_timing(timing: dict) -> dict:
+    """Shape the engine's flat trace dict into the structured
+    profile=true breakdown one partition contributes (the
+    Elasticsearch-profile / EXPLAIN analogue; schema documented in
+    docs/OBSERVABILITY.md). Phase keys lose their `_ms` suffix; per-
+    dispatch timings and the perf-model prediction are grouped under
+    `dispatches` so measured-vs-documented drift reads off directly."""
+    phases = {
+        k[: -len("_ms")]: v for k, v in timing.items()
+        if k.endswith("_ms") and not k.startswith("dispatch_")
+    }
+    per_dispatch = {
+        k[len("dispatch_"): -len("_ms")]: v for k, v in timing.items()
+        if k.startswith("dispatch_") and k.endswith("_ms")
+    }
+    out: dict = {
+        "phases": phases,
+        "dispatches": {
+            "tags": timing.get("dispatches", []),
+            "count": timing.get("dispatch_count", 0),
+            "path": timing.get("perf_path"),
+            "predicted": timing.get("predicted_dispatches"),
+            "predicted_scan_bytes": timing.get("predicted_scan_bytes"),
+            "per_dispatch_ms": per_dispatch,
+        },
+    }
+    if "doc_count" in timing:
+        out["doc_count"] = timing["doc_count"]
+    if "micro_batch_rows" in timing:
+        out["micro_batch_rows"] = timing["micro_batch_rows"]
+    return out
 
 
 class PSServer:
@@ -191,6 +225,88 @@ class PSServer:
         m.callback_gauge("vearch_ps_partitions",
                          "partitions hosted on this node", (),
                          lambda: {(): float(len(self.engines))})
+
+        # raft replication observability (tentpole: VERDICT weak #2 was
+        # undiagnosable because raft exposed no lag/latency/election
+        # series). Histograms are fed by the per-node observer hook
+        # (_raft_observer); everything else is sampled from node state
+        # at scrape time, so idle partitions cost nothing.
+        self._raft_commit_hist = m.histogram(
+            "vearch_raft_commit_latency_seconds",
+            "append -> quorum-commit wall time per proposal",
+            ("partition",))
+        self._raft_apply_hist = m.histogram(
+            "vearch_raft_apply_latency_seconds",
+            "state-machine apply wall time per log entry",
+            ("partition",))
+
+        def _per_node(fn):
+            def read():
+                return {
+                    (str(pid),): float(fn(node))
+                    for pid, node in list(self.raft_nodes.items())
+                }
+            return read
+
+        def _per_peer(field: str):
+            def read():
+                out = {}
+                for pid, node in list(self.raft_nodes.items()):
+                    for peer, info in node.state()["peers"].items():
+                        out[(str(pid), peer)] = float(info[field])
+                return out
+            return read
+
+        m.callback_gauge("vearch_raft_peer_lag",
+                         "entries this peer trails the leader log end",
+                         ("partition", "peer"), _per_peer("lag"))
+        m.callback_gauge("vearch_raft_peer_next_index",
+                         "leader next_index per peer",
+                         ("partition", "peer"), _per_peer("next"))
+        m.callback_gauge("vearch_raft_peer_ack_age_seconds",
+                         "seconds since this peer acked an append",
+                         ("partition", "peer"), _per_peer("ack_age"))
+        m.callback_gauge("vearch_raft_commit_index",
+                         "raft commit index", ("partition",),
+                         _per_node(lambda n: n.commit))
+        m.callback_gauge("vearch_raft_applied_index",
+                         "raft applied index", ("partition",),
+                         _per_node(lambda n: n.applied))
+        m.callback_gauge("vearch_raft_term",
+                         "raft term", ("partition",),
+                         _per_node(lambda n: n.term))
+        m.callback_gauge("vearch_raft_is_leader",
+                         "1 when this node leads the raft group",
+                         ("partition",),
+                         _per_node(lambda n: 1.0 if n.is_leader else 0.0))
+        m.callback_gauge("vearch_raft_heartbeat_age_seconds",
+                         "seconds since replication liveness was proven "
+                         "(leader: oldest peer ack; follower: leader "
+                         "contact)", ("partition",),
+                         _per_node(lambda n: n.heartbeat_age()))
+
+        def _elections():
+            out = {}
+            for pid, node in list(self.raft_nodes.items()):
+                out[(str(pid), "started")] = float(node.elections_started)
+                out[(str(pid), "won")] = float(node.elections_won)
+            return out
+
+        def _snapshots():
+            out = {}
+            for pid, node in list(self.raft_nodes.items()):
+                out[(str(pid), "sent")] = float(node.snapshots_sent)
+                out[(str(pid), "installed")] = float(
+                    node.snapshots_installed)
+            return out
+
+        m.callback_counter("vearch_raft_elections_total",
+                           "raft elections by outcome",
+                           ("partition", "event"), _elections)
+        m.callback_counter("vearch_raft_snapshots_total",
+                           "raft snapshots by direction",
+                           ("partition", "direction"), _snapshots)
+        register_tracer_metrics(m, self.tracer)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -407,7 +523,28 @@ class PSServer:
             snapshot_fn=lambda _pid=pid: self._take_snapshot(_pid),
             install_fn=lambda data, idx, _pid=pid: self._install_snapshot(
                 _pid, data, idx),
+            observer=self._raft_observer(pid),
         )
+
+    def _raft_observer(self, pid: int):
+        """Raft event sink: latency events feed the /metrics histograms;
+        rare state transitions (elections, leadership changes, snapshot
+        transfers) become spans so they show up in /debug/traces next to
+        the searches they disturbed. Must stay cheap + non-blocking —
+        it can fire under raft locks."""
+
+        def observe(event: str, info: dict) -> None:
+            p = str(pid)
+            if event == "commit":
+                self._raft_commit_hist.observe(info["seconds"], p)
+            elif event == "apply":
+                self._raft_apply_hist.observe(info["seconds"], p)
+            else:
+                self.tracer.record(
+                    f"raft.{event}",
+                    tags={"partition": pid, "node": self.node_id, **info},
+                )
+        return observe
 
     def _apply(self, pid: int, op: dict) -> Any:
         """State-machine apply (reference: raft_state_machine.go:124
@@ -826,12 +963,14 @@ class PSServer:
         gate = self._slow_gate if slow else self._search_gate
         if slow:
             self.slow_routed += 1
+        t_gate = time.time()
         if not gate.acquire(timeout=30.0):
             raise RpcError(
                 429,
                 "partition server %s queue full"
                 % ("slow-search" if slow else "search"),
             )
+        gate_wait_ms = round((time.time() - t_gate) * 1e3, 3)
         rid = str(body.get("request_id") or uuid.uuid4().hex)
         token = uuid.uuid4().hex  # unique even when clients reuse rids
         ctx = RequestContext(rid)
@@ -851,8 +990,30 @@ class PSServer:
         try:
             with span:
                 out = self._do_search(eng, body, vectors, ctx)
-                for phase, ms in (out.get("timing") or {}).items():
-                    span.set_tag(phase, ms)
+                timing = out.get("timing")
+                if timing is not None:
+                    timing["gate_wait_ms"] = gate_wait_ms
+                    # engine phase windows -> real child spans under
+                    # ps.search (gate wait included), so /debug/traces
+                    # shows where the partition's time went
+                    pspans = timing.pop("_phase_spans", None) or []
+                    if span is not NULL_SPAN:
+                        sctx = span.ctx()
+                        self.tracer.record(
+                            "ps.gate_wait", ctx=sctx,
+                            start_us=int(t_gate * 1e6),
+                            dur_us=int(gate_wait_ms * 1e3),
+                            tags={"partition": pid},
+                        )
+                        for name, start_us, dur_us in pspans:
+                            self.tracer.record(
+                                name, ctx=sctx, start_us=start_us,
+                                dur_us=dur_us, tags={"partition": pid},
+                            )
+                    for phase, ms in timing.items():
+                        span.set_tag(phase, ms)
+                if body.get("profile"):
+                    out["profile"] = _profile_from_timing(timing or {})
                 return out
         except RequestKilled as e:
             raise RpcError(408, f"request {rid}: {e}") from e
@@ -867,7 +1028,9 @@ class PSServer:
             self._search_ewma[pid] = 0.8 * prev + 0.2 * ms
 
     def _do_search(self, eng, body, vectors, ctx=None) -> dict:
-        trace = {} if body.get("trace") else None
+        # profile implies timing: the explain surface needs the engine's
+        # phase breakdown even when the client didn't ask for a trace
+        trace = {} if (body.get("trace") or body.get("profile")) else None
         columnar = bool(
             body.get("columnar_wire") and body.get("include_fields") == []
         )

@@ -58,6 +58,7 @@ class RaftNode:
         quorum_timeout: float = 10.0,
         election_timeout: float | None = None,
         route_prefix: str = "/ps/raft",
+        observer: Callable[[str, dict], None] | None = None,
     ):
         self.pid = pid
         self.node_id = node_id
@@ -88,6 +89,25 @@ class RaftNode:
         # observability (parity checks + tests assert the catch-up path)
         self.snapshots_sent = 0
         self.snapshots_installed = 0
+        self.elections_started = 0
+        self.elections_won = 0
+        self.heartbeats_acked = 0  # successful append responses sent out
+        # event sink for the hosting PS (metrics histograms + trace
+        # spans). Called OUTSIDE the propose path's critical section for
+        # latency events, but may fire under self._lock for rare state
+        # transitions — the observer must be cheap, non-blocking, and
+        # must never call back into this node.
+        self._observer = observer
+        # leader-side per-peer liveness: last successful append/snapshot
+        # ack, and the highest commit index the peer has been TOLD about
+        # (a follower that has every entry but a stale commit index is
+        # still lagging — it hasn't applied)
+        self._last_peer_ack: dict[int, float] = {}
+        self._peer_commit: dict[int, int] = {}
+        # missed-wakeup guard (VERDICT weak #2): a sync requested while
+        # another sync to the same peer is in flight must not be lost —
+        # the in-flight holder re-probes before releasing the peer lock
+        self._resync_pending: set[int] = set()
 
         # -- voted election mode (metadata groups; data partitions keep
         # master-arbitrated fencing). Standard raft: randomized timeout,
@@ -95,6 +115,7 @@ class RaftNode:
         # only entries of the current term by counting (a no-op entry
         # appended on election carries prior-term entries).
         self.election_timeout = election_timeout
+        self._born = time.time()  # baseline for ack ages before first ack
         self._last_leader_contact = time.time()
         self.leader_hint: int | None = node_id if is_leader else None
         import random
@@ -114,13 +135,60 @@ class RaftNode:
     def quorum(self) -> int:
         return len(self.members) // 2 + 1
 
+    def _observe(self, event: str, info: dict) -> None:
+        if self._observer is None:
+            return
+        try:
+            self._observer(event, info)
+        except Exception:
+            pass  # observability must never fail the protocol
+
+    def replication_lag(self) -> dict[int, int]:
+        """Per-peer entries behind the leader's log end (leader view).
+        A peer at lag 0 holds every entry; whether it has APPLIED them
+        rides the commit index, tracked separately in state()."""
+        with self._lock:
+            last = self.wal.last_index
+            return {
+                p: max(0, last - self._match.get(p, 0))
+                for p in self.members if p != self.node_id
+            }
+
+    def heartbeat_age(self) -> float:
+        """Seconds since this node last saw proof of a live replication
+        channel: for a leader, the OLDEST peer ack (worst case across
+        followers); for a follower, the last leader contact."""
+        now = time.time()
+        with self._lock:
+            if self.is_leader:
+                peers = [m for m in self.members if m != self.node_id]
+                if not peers:
+                    return 0.0
+                return max(
+                    now - self._last_peer_ack.get(p, self._born) for p in peers
+                )
+            return now - self._last_leader_contact
+
     def state(self) -> dict:
         with self._lock:
+            now = time.time()
+            last = self.wal.last_index
+            peers = {
+                str(p): {
+                    "next": self._next.get(p, last + 1),
+                    "match": self._match.get(p, 0),
+                    "lag": max(0, last - self._match.get(p, 0)),
+                    "ack_age": round(
+                        now - self._last_peer_ack.get(p, self._born), 3
+                    ),
+                }
+                for p in self.members if p != self.node_id
+            } if self.is_leader else {}
             return {
                 "pid": self.pid,
                 "node_id": self.node_id,
                 "term": self.term,
-                "last_index": self.wal.last_index,
+                "last_index": last,
                 "last_term": self.wal.last_term,
                 "commit": self.commit,
                 "applied": self.applied,
@@ -130,6 +198,9 @@ class RaftNode:
                 "members": list(self.members),
                 "snapshots_sent": self.snapshots_sent,
                 "snapshots_installed": self.snapshots_installed,
+                "elections_started": self.elections_started,
+                "elections_won": self.elections_won,
+                "peers": peers,
             }
 
     # -- leader: propose + replicate -----------------------------------------
@@ -153,6 +224,7 @@ class RaftNode:
                 ]
                 self.wal.append(entries, fsync=True)
                 target = entries[-1]["index"]
+            t_append = time.time()
             self._replicate_and_wait(target)
             with self._lock:
                 if self.commit < target:
@@ -161,6 +233,12 @@ class RaftNode:
                         f"partition {self.pid}: no quorum for index "
                         f"{target} within {self.quorum_timeout}s",
                     )
+            # append -> quorum-commit wall time (the replication RTT the
+            # client write waited for)
+            self._observe("commit", {
+                "seconds": time.time() - t_append, "index": target,
+                "entries": len(entries),
+            })
             self._apply_to_commit()
             # push the advanced commit index to followers synchronously
             # so they apply before the client sees the ack — follower
@@ -189,12 +267,30 @@ class RaftNode:
 
     def _sync_peer(self, peer: int, blocking: bool = False) -> None:
         """Bring one follower up to date (serialised per peer: append
-        order to a given follower must be monotonic)."""
+        order to a given follower must be monotonic).
+
+        Missed-wakeup fix (VERDICT weak #2): the old non-blocking path
+        silently DROPPED a sync request when another sync to the same
+        peer held the lock. Under CPU contention the holder could be
+        descheduled for seconds while every heartbeat tick's retry was
+        discarded at this early-return — a follower one entry (or one
+        commit-index update) behind then stayed behind until the next
+        proposal. Now a contended request parks in _resync_pending and
+        the holder re-probes before releasing, so a requested sync is
+        never lost."""
         lock = self._peer_locks.setdefault(peer, threading.Lock())
         if not lock.acquire(blocking=blocking):
-            return  # a sync to this peer is already running
+            self._resync_pending.add(peer)
+            # the holder may have checked the flag just before we set
+            # it; retry the handoff if the lock is now free
+            if not lock.acquire(blocking=False):
+                return
         try:
-            self._sync_peer_locked(peer)
+            while True:
+                self._resync_pending.discard(peer)
+                self._sync_peer_locked(peer)
+                if peer not in self._resync_pending or self._stopped:
+                    return
         finally:
             lock.release()
 
@@ -273,8 +369,21 @@ class RaftNode:
                         self._match.get(peer, 0), sent_last
                     )
                     self._next[peer] = sent_last + 1
+                    self._last_peer_ack[peer] = time.time()
+                    self.heartbeats_acked += 1
+                    # the follower adopted min(commit we sent, its log
+                    # end) — remember it so the heartbeat keeps probing
+                    # until the peer has both every ENTRY and the
+                    # current COMMIT index (a peer with a stale commit
+                    # hasn't applied: it is still lagging even at
+                    # match == last_index)
+                    self._peer_commit[peer] = max(
+                        self._peer_commit.get(peer, 0),
+                        min(commit, sent_last),
+                    )
                     self._advance_commit()
-                    if self._next[peer] > self.wal.last_index:
+                    if (self._next[peer] > self.wal.last_index
+                            and self._peer_commit[peer] >= self.commit):
                         return
                 else:
                     # follower nack: jump next_index to its log end + 1
@@ -344,8 +453,12 @@ class RaftNode:
         with self._lock:
             self._match[peer] = max(self._match.get(peer, 0), peer_last)
             self._next[peer] = peer_last + 1
+            self._last_peer_ack[peer] = time.time()
             self.snapshots_sent += 1
             self._advance_commit()
+        self._observe("snapshot_sent", {
+            "peer": peer, "snap_index": snap_index, "bytes": len(data),
+        })
         return True
 
     def tick(self) -> None:
@@ -375,7 +488,11 @@ class RaftNode:
                     e = self.wal.get(nxt)
                 if e is None:
                     break  # compacted (snapshot already covers it)
+                t_apply = time.time()
                 result = self.apply_fn(e["op"])
+                self._observe("apply", {
+                    "seconds": time.time() - t_apply, "index": nxt,
+                })
                 out[nxt] = result
                 with self._lock:
                     self.applied = nxt
@@ -492,6 +609,8 @@ class RaftNode:
             self._election_jitter = random.uniform(0.8, 1.6)
             last_index, last_term = self.wal.last_index, self.wal.last_term
             peers = [m for m in self.members if m != self.node_id]
+            self.elections_started += 1
+        self._observe("election_started", {"term": term})
         votes = 1
         for p in peers:
             try:
@@ -515,7 +634,10 @@ class RaftNode:
                 return
             self.is_leader = True
             self.leader_hint = self.node_id
+            self.elections_won += 1
+            self._observe("election_won", {"term": term, "votes": votes})
             self._match = {}
+            self._peer_commit = {}
             self._next = {
                 p: self.wal.last_index + 1 for p in peers
             }
@@ -565,6 +687,8 @@ class RaftNode:
             return self.state()
 
     def _step_down(self, term: int) -> None:
+        if self.is_leader:
+            self._observe("step_down", {"term": term})
         self.is_leader = False
         if term > self.wal.term:
             self.wal.term = term
@@ -577,8 +701,11 @@ class RaftNode:
                 raise RpcError(409, f"stale term {term} < {self.term}")
             self.wal.term = term
             self.members = list(members)
+            if not self.is_leader:
+                self._observe("become_leader", {"term": term})
             self.is_leader = True
             self._match = {}
+            self._peer_commit = {}
             self._next = {
                 p: self.wal.last_index + 1
                 for p in members if p != self.node_id
@@ -602,6 +729,9 @@ class RaftNode:
                     self._next[p] = self.wal.last_index + 1
             self._match = {
                 p: v for p, v in self._match.items() if p in members
+            }
+            self._peer_commit = {
+                p: v for p, v in self._peer_commit.items() if p in members
             }
             self.wal.save_meta(fsync=True)
             if self.is_leader:
@@ -675,6 +805,7 @@ class RaftNode:
                 self.applied = snap_index
                 self.snapshots_installed += 1
                 self.wal.save_meta(fsync=True)
+        self._observe("snapshot_installed", {"snap_index": snap_index})
         return {"success": True, "term": self.term,
                 "last_index": self.wal.last_index}
 

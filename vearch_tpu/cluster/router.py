@@ -95,6 +95,9 @@ class RouterServer:
         s.route("GET", "/cluster/health", self._h_health)
         s.route("GET", "/router/stats", self._h_router_stats)
         s.tracer = self.tracer  # serves GET /debug/traces
+        from vearch_tpu.cluster.metrics import register_tracer_metrics
+
+        register_tracer_metrics(s.metrics, self.tracer)
 
     def start(self) -> None:
         self.server.start()
@@ -683,6 +686,10 @@ class RouterServer:
             "sort": sort_specs or None,
             "index_params": body.get("index_params") or {},
             "trace": bool(body.get("trace", False)),
+            # profile=true: the PS returns its structured per-phase,
+            # per-dispatch breakdown, merged below (the Elasticsearch-
+            # profile / EXPLAIN analogue)
+            "profile": bool(body.get("profile", False)),
             "field_weights": {
                 r["field"]: r["weight"]
                 for r in body.get("ranker", {}).get("params", [])
@@ -731,6 +738,7 @@ class RouterServer:
             ]
             results = [f.result() for f in futures]
             partials = [r for _, r in results]
+            t_merge = _time.time()
             if sort_specs:
                 merged = self._merge_search_sorted(
                     partials, sort_specs, k, start, size)
@@ -766,6 +774,19 @@ class RouterServer:
                 out["params"] = {
                     str(pid): {"rpc_ms": r["_rpc_ms"], **r.get("timing", {})}
                     for pid, r in results
+                }
+            if body.get("profile"):
+                # router-merged explain surface: each partition's
+                # structured phase/dispatch breakdown plus the router's
+                # own scatter RTT and merge cost
+                out["profile"] = {
+                    "partitions": {
+                        str(pid): {"rpc_ms": r["_rpc_ms"],
+                                   **(r.get("profile") or {})}
+                        for pid, r in results
+                    },
+                    "merge_ms": round((_time.time() - t_merge) * 1e3, 3),
+                    "partition_count": len(results),
                 }
             return out
 

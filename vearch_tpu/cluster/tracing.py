@@ -228,6 +228,27 @@ class Tracer:
         parent = (ctx or {}).get("parent")
         return Span(self, name, trace_id, parent, tags)
 
+    def record(self, name: str, ctx: dict | None = None,
+               start_us: int | None = None, dur_us: int = 0,
+               tags: dict | None = None, status: str = "ok") -> Span:
+        """Emit an already-measured span retroactively.
+
+        The engine measures its phase windows inline (no tracer in
+        scope) and ships them up as `[name, start_us, dur_us]` rows; the
+        PS replays them here as child spans with their REAL wall
+        windows, so /debug/traces shows coarse-quantize/scan/rerank
+        timing nested under ps.search. Also used for rare raft events
+        (elections, snapshot installs) that have no request context."""
+        trace_id = (ctx or {}).get("trace_id") or uuid.uuid4().hex
+        parent = (ctx or {}).get("parent")
+        sp = Span(self, name, trace_id, parent, tags)
+        if start_us is not None:
+            sp.start_us = int(start_us)
+        sp.dur_us = max(int(dur_us), 0)
+        sp.status = status
+        self._finish(sp)
+        return sp
+
     def _finish(self, span: Span) -> None:
         d = span.to_dict()
         with self._lock:

@@ -846,75 +846,180 @@ class Engine:
     def _search_direct(self, req: SearchRequest) -> list[SearchResult]:
         if not req.vectors:
             raise ValueError("search needs at least one vector field")
-        n = self.table.doc_count
-        if req.filters is not None:
-            from vearch_tpu.scalar.filter import evaluate_filter
-
-            valid = self.bitmap.valid_mask(n) & evaluate_filter(
-                req.filters, self, n
-            )
-        else:
-            # no filter -> the alive mask only changes on writes; keep it
-            # device-resident so the hot path skips a [n]-bool H2D upload
-            valid = self._device_alive_mask(n)
-
         import time as _time
 
-        t_start = _time.time()
-        metrics = {self.indexes[name].metric for name in req.vectors}
-        if len(metrics) > 1:
-            raise ValueError(
-                "multi-field search requires a single metric across fields; "
-                f"got {[m.value for m in metrics]}"
-            )
+        # Phase profiling (observability tentpole): when req.trace is a
+        # dict, every engine phase records its wall window — both as a
+        # flat `{phase}_ms` key (the profile=true breakdown) and as a
+        # `_phase_spans` [name, start_us, dur_us] list the PS turns into
+        # retroactive child spans under ps.search. A per-request
+        # dispatch capture (ops/ivf.py) records which device programs
+        # this search launched so the trace can carry measured dispatches
+        # next to the perf model's DOCUMENTED_DISPATCHES prediction.
+        tracing = req.trace is not None
+        phases: list[tuple[str, float, float]] = []
+        capture = None
+        if tracing:
+            from vearch_tpu.ops import ivf as _ivf_ops
 
-        per_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
-        queries_by_field: dict[str, np.ndarray] = {}
-        fetch_k = req.k if len(req.vectors) == 1 else max(req.k * 4, 50)
-        for name, queries in req.vectors.items():
-            if req.ctx is not None:
-                req.ctx.check()
-            index = self.indexes[name]
-            queries = np.asarray(queries)
-            if queries.ndim == 1:
-                queries = queries[None, :]
-            queries = index.decode_input(
-                queries.reshape(queries.shape[0], index.input_dim)
-            )
-            queries_by_field[name] = queries
-            store = self.vector_stores[name]
-            use_index = index.trained and not req.brute_force
-            if use_index:
-                if index.indexed_count < store.count:
-                    # realtime pump: absorb rows that arrived since the
-                    # last pass (reference: AddRTVecsToIndex)
-                    index.absorb(store.count)
-                scores, ids = index.search(
-                    queries, fetch_k, valid, req.index_params or None
+            capture = _ivf_ops.begin_capture()
+        try:
+            t_start = _time.time()
+            n = self.table.doc_count
+            if req.filters is not None:
+                from vearch_tpu.scalar.filter import evaluate_filter
+
+                valid = self.bitmap.valid_mask(n) & evaluate_filter(
+                    req.filters, self, n
                 )
             else:
-                # brute-force fallback below training threshold
-                # (reference: engine.cc:280-302)
-                from vearch_tpu.index.flat import FlatIndex
+                # no filter -> the alive mask only changes on writes;
+                # keep it device-resident so the hot path skips a
+                # [n]-bool H2D upload
+                valid = self._device_alive_mask(n)
+            if tracing:
+                t_filter = _time.time()
+                req.trace["filter_ms"] = round((t_filter - t_start) * 1e3, 3)
+                phases.append(("engine.filter", t_start, t_filter))
 
-                flat = FlatIndex(
-                    IndexParams(metric_type=index.metric), store
-                )
-                scores, ids = flat.search(queries, fetch_k, valid)
-            per_field[name] = (scores, ids)
-            if req.trace is not None:
-                req.trace[f"search_{name}_ms"] = round(
-                    (_time.time() - t_start) * 1e3, 3
+            metrics = {self.indexes[name].metric for name in req.vectors}
+            if len(metrics) > 1:
+                raise ValueError(
+                    "multi-field search requires a single metric across "
+                    f"fields; got {[m.value for m in metrics]}"
                 )
 
-        if req.ctx is not None:
-            req.ctx.check()
-        merged = self._merge_fields(per_field, queries_by_field, req)
-        results = self._shape_results(merged, req)
-        if req.trace is not None:
-            req.trace["total_ms"] = round((_time.time() - t_start) * 1e3, 3)
-            req.trace["doc_count"] = self.doc_count
-        return results
+            per_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+            queries_by_field: dict[str, np.ndarray] = {}
+            fetch_k = req.k if len(req.vectors) == 1 else max(req.k * 4, 50)
+            for name, queries in req.vectors.items():
+                if req.ctx is not None:
+                    req.ctx.check()
+                t_field = _time.time()
+                index = self.indexes[name]
+                queries = np.asarray(queries)
+                if queries.ndim == 1:
+                    queries = queries[None, :]
+                queries = index.decode_input(
+                    queries.reshape(queries.shape[0], index.input_dim)
+                )
+                queries_by_field[name] = queries
+                store = self.vector_stores[name]
+                use_index = index.trained and not req.brute_force
+                if use_index:
+                    if index.indexed_count < store.count:
+                        # realtime pump: absorb rows that arrived since
+                        # the last pass (reference: AddRTVecsToIndex)
+                        index.absorb(store.count)
+                    scores, ids = index.search(
+                        queries, fetch_k, valid, req.index_params or None
+                    )
+                else:
+                    # brute-force fallback below training threshold
+                    # (reference: engine.cc:280-302)
+                    from vearch_tpu.index.flat import FlatIndex
+
+                    flat = FlatIndex(
+                        IndexParams(metric_type=index.metric), store
+                    )
+                    scores, ids = flat.search(queries, fetch_k, valid)
+                per_field[name] = (scores, ids)
+                if tracing:
+                    from vearch_tpu.ops import ivf as _ivf_ops
+
+                    # close the open dispatch window: device work for
+                    # this field is done (device_get already blocked)
+                    _ivf_ops.capture_mark()
+                    t_done = _time.time()
+                    req.trace[f"search_{name}_ms"] = round(
+                        (t_done - t_field) * 1e3, 3
+                    )
+                    phases.append((f"engine.search.{name}", t_field, t_done))
+
+            if req.ctx is not None:
+                req.ctx.check()
+            t_merge = _time.time()
+            merged = self._merge_fields(per_field, queries_by_field, req)
+            t_shape = _time.time()
+            results = self._shape_results(merged, req)
+            if tracing:
+                t_end = _time.time()
+                req.trace["merge_ms"] = round((t_shape - t_merge) * 1e3, 3)
+                req.trace["shape_ms"] = round((t_end - t_shape) * 1e3, 3)
+                phases.append(("engine.merge", t_merge, t_shape))
+                phases.append(("engine.shape", t_shape, t_end))
+                req.trace["total_ms"] = round((t_end - t_start) * 1e3, 3)
+                req.trace["doc_count"] = self.doc_count
+            return results
+        finally:
+            if capture is not None:
+                from vearch_tpu.ops import ivf as _ivf_ops
+
+                _ivf_ops.end_capture()
+                self._record_dispatch_trace(req, capture, phases)
+
+    def _record_dispatch_trace(self, req, capture, phases) -> None:
+        """Fold the per-request dispatch capture + phase windows into
+        req.trace: measured dispatches (tags, per-dispatch wall ms) next
+        to the perf model's prediction for the matched serving path, so
+        model drift is visible per request (ROADMAP: perf gates as live
+        signals). `_phase_spans` is consumed by cluster/ps.py to emit
+        engine/kernel child spans."""
+        from vearch_tpu.ops import perf_model
+
+        trace = req.trace
+        if trace is None:
+            return
+        tags = capture.tags
+        trace["dispatches"] = tags
+        trace["dispatch_count"] = len(tags)
+        for tag, t0, t1 in capture.events:
+            if t1 is not None:
+                key = f"dispatch_{tag}_ms"
+                trace[key] = round(
+                    trace.get(key, 0.0) + (t1 - t0) * 1e3, 3
+                )
+        path = perf_model.path_for_dispatches(tags)
+        if path is not None:
+            trace["perf_path"] = path
+            trace["predicted_dispatches"] = list(
+                perf_model.DOCUMENTED_DISPATCHES[path]
+            )
+        trace["predicted_scan_bytes"] = sum(
+            self._predicted_scan_bytes(name) for name in req.vectors
+        )
+        # extend, don't replace: the microbatcher may have noted its
+        # queue wait on this trace before the search ran
+        spans = list(trace.get("_phase_spans") or [])
+        spans += [
+            [name, int(t0 * 1e6), int((t1 - t0) * 1e6)]
+            for name, t0, t1 in phases
+        ]
+        spans.extend(
+            [f"kernel.{tag}", int(t0 * 1e6), int((t1 - t0) * 1e6)]
+            for tag, t0, t1 in capture.events
+            if t1 is not None
+        )
+        trace["_phase_spans"] = spans
+
+    def _predicted_scan_bytes(self, name: str) -> int:
+        """Perf-model prediction of stage-1 scan HBM read bytes for one
+        field (ops/perf_model.scan_traffic_bytes): the int8 mirror when
+        one is published, else the raw store rows."""
+        from vearch_tpu.ops import perf_model
+
+        index = self.indexes[name]
+        store = self.vector_stores[name]
+        d = store.dimension
+        mirror = getattr(index, "_mirror", None)
+        try:
+            if mirror is not None and getattr(mirror, "_h8", None) is not None:
+                return perf_model.scan_traffic_bytes(
+                    1, int(mirror._h8.shape[0]), d, "xla_full"
+                )
+        except Exception:
+            pass
+        return int(store.count) * d * int(store.store_dtype.itemsize)
 
     def _exact_score(
         self, name: str, query: np.ndarray, docids: list[int]

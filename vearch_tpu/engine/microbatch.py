@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import TYPE_CHECKING, Any
 
 import numpy as np
@@ -39,7 +40,7 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 class _Pending:
-    __slots__ = ("req", "rows", "done", "results", "error")
+    __slots__ = ("req", "rows", "done", "results", "error", "t_enqueue")
 
     def __init__(self, req: "SearchRequest", rows: int):
         self.req = req
@@ -47,6 +48,25 @@ class _Pending:
         self.done = threading.Event()
         self.results: "list[SearchResult] | None" = None
         self.error: Exception | None = None
+        # queue-wait observability: stamped at submit(), read by
+        # _run_group to report how long this request sat behind the
+        # in-flight device dispatch (trace key queue_ms + a
+        # microbatch.queue phase span)
+        self.t_enqueue = time.time()
+
+
+def _note_queue_wait(p: "_Pending", t_dequeue: float) -> None:
+    """Record the microbatch queue wait on a traced pending request."""
+    if p.req.trace is None:
+        return
+    wait_ms = max(0.0, (t_dequeue - p.t_enqueue) * 1e3)
+    p.req.trace["queue_ms"] = round(wait_ms, 3)
+    # copy-on-write: the group trace dict (and its _phase_spans list) is
+    # shared by every pending in the group — never mutate the shared list
+    spans = list(p.req.trace.get("_phase_spans") or [])
+    spans.append(["microbatch.queue", int(p.t_enqueue * 1e6),
+                  int(wait_ms * 1e3)])
+    p.req.trace["_phase_spans"] = spans
 
 
 def _compat_key(req: "SearchRequest") -> str:
@@ -160,9 +180,11 @@ class MicroBatcher:
         return order
 
     def _run_group(self, group: list[_Pending]) -> None:
+        t_dequeue = time.time()
         if len(group) == 1:
             p = group[0]
             try:
+                _note_queue_wait(p, t_dequeue)
                 p.results = self.engine._search_direct(p.req)
             except Exception as e:
                 p.error = e
@@ -232,5 +254,6 @@ class MicroBatcher:
                 p.req.trace["micro_batch_rows"] = sum(
                     g.rows for g in group
                 )
+                _note_queue_wait(p, t_dequeue)
             p.results = sub
             p.done.set()

@@ -27,6 +27,8 @@ per-request nprobe changes recompile once per distinct value (cached).
 from __future__ import annotations
 
 import functools
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +55,68 @@ def set_dispatch_ledger(ledger: list | None) -> None:
     _dispatch_ledger = ledger
 
 
+# Per-request dispatch capture (observability tentpole): a thread-local
+# recorder layered on top of the process-global ledger. The engine
+# installs one per search so the profile/trace surface can report which
+# device programs THIS request launched and roughly how long each took,
+# without touching the index call sites (they keep calling
+# note_dispatch). A tag's wall window closes at the next note_dispatch
+# or at an explicit capture_mark()/end_capture() — on the CPU backend
+# the blocking device_get sits inside that window, so the times are
+# host-observed per-dispatch costs, not pure kernel times.
+_capture_tls = threading.local()
+
+
+class DispatchCapture:
+    __slots__ = ("events",)
+
+    def __init__(self) -> None:
+        # [tag, start_epoch_s, end_epoch_s | None]
+        self.events: list[list] = []
+
+    def note(self, tag: str) -> None:
+        now = time.time()
+        if self.events and self.events[-1][2] is None:
+            self.events[-1][2] = now
+        self.events.append([tag, now, None])
+
+    def mark(self) -> None:
+        """Close the open dispatch window (call when device work for the
+        current index.search has completed)."""
+        if self.events and self.events[-1][2] is None:
+            self.events[-1][2] = time.time()
+
+    @property
+    def tags(self) -> list[str]:
+        return [e[0] for e in self.events]
+
+
+def begin_capture() -> DispatchCapture:
+    cap = DispatchCapture()
+    _capture_tls.capture = cap
+    return cap
+
+
+def capture_mark() -> None:
+    cap = getattr(_capture_tls, "capture", None)
+    if cap is not None:
+        cap.mark()
+
+
+def end_capture() -> DispatchCapture | None:
+    cap = getattr(_capture_tls, "capture", None)
+    _capture_tls.capture = None
+    if cap is not None:
+        cap.mark()
+    return cap
+
+
 def note_dispatch(tag: str) -> None:
     if _dispatch_ledger is not None:
         _dispatch_ledger.append(tag)
+    cap = getattr(_capture_tls, "capture", None)
+    if cap is not None:
+        cap.note(tag)
 
 
 def _coarse_probes(

@@ -115,6 +115,18 @@ DOCUMENTED_DISPATCHES: dict[str, list[str]] = {
 }
 
 
+def path_for_dispatches(tags: list[str]) -> str | None:
+    """Reverse lookup: which documented serving path launched exactly
+    this dispatch sequence? None when the sequence matches no documented
+    path (e.g. a multi-field search concatenates several paths) — the
+    profile surface reports that as drift instead of guessing."""
+    seq = list(tags)
+    for path, doc in DOCUMENTED_DISPATCHES.items():
+        if seq == doc:
+            return path
+    return None
+
+
 # -- 2. compiled-program tracking -------------------------------------------
 
 _JIT_REGISTRY: dict[str, Any] = {}

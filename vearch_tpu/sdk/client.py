@@ -87,7 +87,15 @@ class VearchClient:
         sort: Any = None,
         page_size: int | None = None,
         page_num: int | None = None,
-    ) -> list[list[dict]]:
+        profile: bool = False,
+    ) -> list[list[dict]] | dict:
+        """Search `space_name`; returns per-query hit lists.
+
+        With ``profile=True`` the full response dict comes back instead:
+        ``documents`` plus a router-merged ``profile`` breakdown —
+        per-partition phase timings, measured dispatch tags vs the perf
+        model's documented prediction, and router merge cost (schema in
+        docs/OBSERVABILITY.md)."""
         # features ride as ndarrays: the RPC layer's binary tensor codec
         # ships a [b*d] f32 buffer instead of tens of thousands of JSON
         # floats (a large-batch query upload was ~30% of e2e latency)
@@ -115,6 +123,9 @@ class VearchClient:
             body["page_size"] = page_size
         if page_num is not None:
             body["page_num"] = page_num
+        if profile:
+            body["profile"] = True
+            return rpc.call(self.addr, "POST", "/document/search", body)
         if columnar and fields == []:
             # fields-free throughput mode: scores ride as ONE binary f32
             # buffer instead of b*k JSON dicts; reshaped here so the
