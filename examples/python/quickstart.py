@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import sys
 import tempfile
+import time
 
 REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -54,6 +55,7 @@ def main() -> int:
             })
 
             vecs = rng.standard_normal((N, D)).astype(np.float32)
+            extra = rng.standard_normal((20, D)).astype(np.float32)
             client.upsert("quickstart", "articles", [
                 {"_id": f"doc-{i}", "topic": i % 5, "embedding": vecs[i]}
                 for i in range(N)
@@ -86,6 +88,67 @@ def main() -> int:
                       f"(path={disp['path']}, "
                       f"predicted={disp['predicted']})")
                 assert disp["tags"] == disp["predicted"]
+
+            # profiled write: the same explain surface for mutations —
+            # raft propose wait, WAL append+fsync, commit wait, apply
+            out = client.upsert("quickstart", "articles", [
+                {"_id": f"doc-{N + i}", "topic": i % 5,
+                 "embedding": extra[i]}
+                for i in range(20)
+            ], profile=True)
+            wprof = out["profile"]
+            print(f"\nwrite profile ({wprof['partition_count']} "
+                  f"partitions, router merge {wprof['merge_ms']} ms):")
+            for pid, part in sorted(wprof["partitions"].items()):
+                print(f"  partition {pid}: rpc {part['rpc_ms']} ms, "
+                      f"{part['doc_count']} docs")
+                for phase in ("propose_wait", "wal_append",
+                              "commit_wait", "apply", "total"):
+                    print(f"    {phase:<14} "
+                          f"{part['phases'][phase]:8.3f} ms")
+
+            # background index build, watched through GET /ps/jobs
+            from vearch_tpu.cluster import rpc
+
+            print("\nbackground index build:")
+            for ps in cluster.ps_nodes:
+                for pid in list(ps.engines):
+                    rpc.call(ps.addr, "POST", "/ps/index/build",
+                             {"partition_id": pid, "background": True})
+            for ps in cluster.ps_nodes:
+                while True:
+                    jobs = rpc.call(ps.addr, "GET", "/ps/jobs")["jobs"]
+                    if jobs and all(j["status"] != "running"
+                                    for j in jobs):
+                        break
+                    time.sleep(0.05)
+                for j in sorted(jobs, key=lambda j: j["partition_id"]):
+                    print(f"  partition {j['partition_id']}: "
+                          f"{j['op']} {j['status']} in "
+                          f"{j['duration_seconds']}s "
+                          f"(phases {sorted(j['phases_ms'])})")
+
+            # slow-query log: an absurdly low threshold logs every
+            # search with its phase breakdown (ops would use ~500ms)
+            for ps in cluster.ps_nodes:
+                pid = next(iter(ps.engines))
+                rpc.call(ps.addr, "POST", "/ps/engine/config",
+                         {"partition_id": pid,
+                          "config": {"slow_log_ms": 0.001}})
+            client.search(
+                "quickstart", "articles",
+                [{"field": "embedding", "feature": vecs[7]}], limit=3)
+            print("\nslow-query log (threshold 0.001 ms):")
+            logged = 0
+            for ps in cluster.ps_nodes:
+                log = rpc.call(ps.addr, "GET", "/debug/slowlog")
+                for e in log["entries"]:
+                    logged += 1
+                    print(f"  partition {e['partition']}: {e['op']} "
+                          f"{e['elapsed_ms']} ms "
+                          f"(phases {sorted(e['phases'])})")
+            assert logged >= 1
+
             print("\nquickstart OK")
             return 0
         finally:

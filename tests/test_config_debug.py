@@ -117,12 +117,15 @@ def test_request_kill_switch(tmp_path, rng):
 
         _time.sleep(0.7)  # let the killer re-arm at the fast tick
         # first search compiles (>> 1ms): the killer flips the ctx and
-        # the engine aborts at its next phase boundary -> 408
+        # the engine aborts at its next phase boundary with the
+        # terminal request_killed code (never retried by the router)
+        from vearch_tpu.cluster.rpc import ERR_REQUEST_KILLED
+
         with _pytest.raises(rpc.RpcError, match="killed") as ei:
             rpc.call(ps.addr, "POST", "/ps/doc/search",
                      {"partition_id": 1, "vectors": {"v": vecs[:3]},
                       "k": 5, "request_id": "victim"})
-        assert ei.value.code == 408
+        assert ei.value.code == ERR_REQUEST_KILLED
         assert rpc.call(ps.addr, "GET", "/ps/stats")["killed_requests"] >= 1
         # disable the killer: the same search now completes
         rpc.call(ps.addr, "POST", "/ps/engine/config",

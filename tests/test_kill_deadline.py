@@ -1,0 +1,198 @@
+"""Deadline killer + operator kill abort an in-flight search BETWEEN
+device dispatches (observability satellite).
+
+The engine checks `RequestContext` at two phase boundaries: per-field
+before each index dispatch (engine.py vectors loop) and once more
+before the merge. Patching the in-process engine's index `search` to
+sleep lets a kill land deterministically in that window — and the
+patch's call counter proves the router issued exactly ONE attempt:
+ERR_REQUEST_KILLED (499) is terminal and must never be retried as a
+failover.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import vearch_tpu.cluster.rpc as rpc
+from vearch_tpu.cluster.rpc import ERR_REQUEST_KILLED
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 16
+
+
+class _SlowIndexSearch:
+    """Wraps an index's bound `search`, sleeping before delegating and
+    counting invocations (one invocation == one engine dispatch
+    attempt)."""
+
+    def __init__(self, inner, delay_s: float):
+        self.inner = inner
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return self.inner(*args, **kwargs)
+
+
+def _fetch_json(addr: str, path: str) -> dict:
+    with urllib.request.urlopen(f"http://{addr}{path}") as r:
+        return json.loads(r.read().decode())
+
+
+def _scrape(addr: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}/metrics") as r:
+        return r.read().decode()
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("kill") / "c"), n_ps=1)
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((40, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(40)])
+    # warm the serving path so compile time never races the deadlines
+    _search(c, vecs[:2])
+    yield c, vecs
+    c.stop()
+
+
+def _search(c: StandaloneCluster, qs: np.ndarray, **extra) -> dict:
+    return rpc.call(c.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s",
+        "vectors": [{"field": "v", "feature": q.tolist()} for q in qs],
+        "limit": 5, **extra,
+    })
+
+
+def _patched_engine(c: StandaloneCluster, delay_s: float):
+    ps = c.ps_nodes[0]
+    pid = next(iter(ps.engines))
+    eng = ps.engines[pid]
+    slow = _SlowIndexSearch(eng.indexes["v"].search, delay_s)
+    eng.indexes["v"].search = slow
+    return ps, eng, slow
+
+
+def test_deadline_kills_between_dispatches_no_retry(cluster):
+    c, vecs = cluster
+    ps, eng, slow = _patched_engine(c, delay_s=0.4)
+    try:
+        with pytest.raises(rpc.RpcError, match="request_killed") as ei:
+            _search(c, vecs[:2], deadline_ms=60,
+                    request_id="victim-deadline")
+        assert ei.value.code == ERR_REQUEST_KILLED
+        assert "deadline" in str(ei.value)
+    finally:
+        eng.indexes["v"].search = slow.inner
+    # the index dispatched exactly once: 499 fell through the router's
+    # failover whitelist instead of re-running the killed work
+    assert slow.calls == 1
+
+    # the kill is counted by reason...
+    page = _scrape(ps.addr)
+    assert 'vearch_requests_killed_total{reason="deadline"}' in page
+    # ...and force-sampled into the slowlog with its phase breakdown
+    # (threshold 0 = disabled for ordinary requests, killed always log)
+    log = rpc.call(ps.addr, "GET", "/debug/slowlog")
+    hits = [e for e in log["entries"]
+            if e["request_id"] == "victim-deadline"]
+    assert hits and hits[0]["killed"]
+    assert hits[0]["reason"] == "deadline exceeded"
+    assert hits[0]["phases"], "killed entry must carry the phase " \
+        "breakdown even though the client never asked to profile"
+    # the router's slowlog records the killed request at its role too
+    rlog = rpc.call(c.router_addr, "GET", "/debug/slowlog")
+    rhits = [e for e in rlog["entries"]
+             if e.get("request_id") == "victim-deadline"]
+    assert rhits and rhits[0]["killed"]
+
+
+def test_ps_config_default_deadline_applies(cluster):
+    """request_deadline_ms from PS config arms the deadline when the
+    search option is absent."""
+    c, vecs = cluster
+    ps, eng, slow = _patched_engine(c, delay_s=0.4)
+    pid = next(iter(ps.engines))
+    rpc.call(ps.addr, "POST", "/ps/engine/config",
+             {"partition_id": pid, "config": {"request_deadline_ms": 60}})
+    try:
+        with pytest.raises(rpc.RpcError, match="request_killed") as ei:
+            _search(c, vecs[:2], request_id="victim-default")
+        assert ei.value.code == ERR_REQUEST_KILLED
+        assert "deadline" in str(ei.value)
+    finally:
+        eng.indexes["v"].search = slow.inner
+        rpc.call(ps.addr, "POST", "/ps/engine/config",
+                 {"partition_id": pid,
+                  "config": {"request_deadline_ms": 0}})
+    assert slow.calls == 1
+    # unarmed again: the same search completes normally
+    out = _search(c, vecs[:2])
+    assert out["documents"]
+
+
+def test_operator_kill_between_dispatches(cluster):
+    c, vecs = cluster
+    ps, eng, slow = _patched_engine(c, delay_s=1.2)
+    caught: list[Exception] = []
+
+    def victim():
+        try:
+            _search(c, vecs[:2], request_id="victim-op")
+        except rpc.RpcError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=victim)
+    t.start()
+    try:
+        # wait until the PS registers the request in flight...
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            reqs = rpc.call(ps.addr, "GET", "/ps/requests")["requests"]
+            if any(r["request_id"] == "victim-op" for r in reqs):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("victim request never showed up in /ps/requests")
+        # ...then kill it by the client-supplied id, mid-dispatch-window
+        out = rpc.call(ps.addr, "POST", "/ps/kill",
+                       {"request_id": "victim-op"})
+        assert out["killed"] >= 1
+        t.join(timeout=10.0)
+    finally:
+        eng.indexes["v"].search = slow.inner
+    assert not t.is_alive()
+    assert caught, "killed search must surface an error to the client"
+    assert caught[0].code == ERR_REQUEST_KILLED
+    assert "request_killed" in str(caught[0])
+    assert slow.calls == 1  # terminal: the router made no second attempt
+
+    page = _scrape(ps.addr)
+    assert 'vearch_requests_killed_total{reason="operator"}' in page
+    # killed-but-untraced requests are force-sampled into /debug/traces
+    spans = _fetch_json(ps.addr, "/debug/traces")["spans"]
+    forced = [s for s in spans
+              if s["name"] == "ps.search"
+              and s.get("tags", {}).get("kill_reason") == "operator"]
+    assert forced, "operator kill must leave a ps.search span"
+    assert "RequestKilled" in forced[0]["status"]
+    assert forced[0]["tags"]["request_id"] == "victim-op"

@@ -91,3 +91,62 @@ def test_profiled_soak_does_not_grow_series(cluster, rng):
             assert not re.search(r'="d\d{1,3}"', line), line
         # and the page stays small in absolute terms
         assert len(_series(text)) < 600, addr
+
+
+def test_profiled_write_soak_does_not_grow_series(cluster, rng):
+    """Write-path mirror of the search soak: 1k profiled upserts plus a
+    full index build after the baseline scrape must not mint a single
+    new series — WAL/apply/build observability is labelled by topology
+    (partition, op), never by request."""
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((100, D)).astype(np.float32)
+
+    def profiled_upsert(i0: int) -> None:
+        out = cl.upsert("db", "s", [
+            {"_id": f"w{i0 + j}", "v": vecs[(i0 + j) % 100]}
+            for j in range(BATCH)
+        ], profile=True)
+        prof = out["profile"]
+        assert prof["partition_count"] >= 1
+        for p in prof["partitions"].values():
+            assert "wal_append" in p["phases"]
+
+    def build_all(op_path: str) -> None:
+        for ps in cluster.ps_nodes:
+            for pid in list(ps.engines):
+                rpc.call(ps.addr, "POST", op_path, {"partition_id": pid})
+
+    addrs = [cluster.router_addr] + [ps.addr for ps in cluster.ps_nodes]
+
+    # warm every write-side code path once (upsert + build + rebuild
+    # histograms, WAL histograms, progress gauges) before the baseline
+    profiled_upsert(0)
+    profiled_upsert(BATCH)
+    build_all("/ps/index/build")
+    build_all("/ps/index/rebuild")
+    baseline = {a: _series(scrape(a)) for a in addrs}
+
+    done = 2 * BATCH
+    while done < N_QUERIES:
+        profiled_upsert(done)
+        done += BATCH
+    # one more full build + rebuild mid-soak: job state transitions and
+    # duration observations must reuse the warmed label sets
+    build_all("/ps/index/build")
+    build_all("/ps/index/rebuild")
+
+    for addr in addrs:
+        text = scrape(addr)
+        grown = _series(text) - baseline[addr]
+        assert not grown, f"{addr}: series grew during write soak: {grown}"
+        assert "trace_id=" not in text
+        for line in text.splitlines():
+            assert not re.search(r'="w\d{1,4}"', line), line
+        assert len(_series(text)) < 600, addr

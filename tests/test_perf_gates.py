@@ -166,6 +166,46 @@ def test_explicit_warmup_pretraces_new_batch_size(ivfpq_engine):
     assert perf_model.total_compiled_programs() == before
 
 
+def test_deadline_and_slowlog_capture_add_zero_device_work(ivfpq_engine):
+    """Arming a per-request deadline and the slowlog's forced phase
+    capture (trace dict) is pure host-side bookkeeping: the warmed
+    serving path must launch the identical dispatch sequence and add
+    ZERO compiled programs versus a plain search."""
+    import time as _time
+
+    from vearch_tpu.engine.engine import RequestContext
+
+    eng, vecs = ivfpq_engine
+    params = {"scan_mode": "full"}
+    _search(eng, vecs, b=8, index_params=params)  # settle first-use
+    plain = _search(eng, vecs, b=8, index_params=params)
+    before = perf_model.total_compiled_programs()
+
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        eng.search(SearchRequest(
+            vectors={"emb": vecs[:8]}, k=10, include_fields=[],
+            index_params=params,
+            # exactly what the PS arms when a deadline or a slowlog
+            # threshold is set: a deadline-bearing context checked
+            # between dispatches + a forced trace dict
+            trace={},
+            ctx=RequestContext("perf-gate",
+                               deadline=_time.time() + 60.0),
+        ))
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    assert ledger.tags == plain.tags, (
+        f"armed search launched {ledger.tags} vs plain {plain.tags}: "
+        "deadline/slowlog instrumentation reached the device"
+    )
+    assert perf_model.total_compiled_programs() == before, (
+        "deadline/slowlog instrumentation compiled new programs on the "
+        "warmed serving path"
+    )
+
+
 # -- gate 3: bytes materialized ----------------------------------------------
 
 

@@ -18,4 +18,10 @@ def test_quickstart_runs_and_prints_profile():
     assert "top hit: doc-42" in out.stdout
     assert "profile (2 partitions" in out.stdout
     assert "dispatches    ['flat_scan']" in out.stdout
+    # write-path explain + job + slowlog walkthroughs (PR 3)
+    assert "write profile (" in out.stdout
+    assert "wal_append" in out.stdout
+    assert "build done in" in out.stdout
+    assert "slow-query log (threshold 0.001 ms):" in out.stdout
+    assert "(phases [" in out.stdout
     assert "quickstart OK" in out.stdout

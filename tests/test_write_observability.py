@@ -1,0 +1,203 @@
+"""Write-path + index-build observability (observability tentpole).
+
+Mirrors the read-path acceptance of PR 2 for mutations: a profiled
+upsert returns the router-merged per-phase breakdown AND leaves a span
+tree (router.upsert -> router.scatter -> ps.upsert -> raft/wal/engine
+phases) in /debug/traces; background index builds are observable jobs
+in GET /ps/jobs with progress and terminal state; the master's
+/cluster/health rolls build state up from heartbeats; and every new
+write-side gauge/histogram renders on /metrics.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import vearch_tpu.cluster.rpc as rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+N_DOCS = 40
+
+WRITE_PHASES = {"propose_wait", "wal_append", "commit_wait", "apply"}
+
+
+def _fetch_json(addr: str, path: str) -> dict:
+    with urllib.request.urlopen(f"http://{addr}{path}") as r:
+        return json.loads(r.read().decode())
+
+
+def _scrape(addr: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}/metrics") as r:
+        return r.read().decode()
+
+
+def _span_names(addr: str, trace_id: str) -> set[str]:
+    spans = _fetch_json(addr, f"/debug/traces?trace_id={trace_id}")["spans"]
+    return {s["name"] for s in spans}
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("wobs") / "c"), n_ps=2)
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    rng = np.random.default_rng(11)
+    vecs = rng.standard_normal((N_DOCS, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(N_DOCS)])
+    yield c, cl, vecs
+    c.stop()
+
+
+def test_profiled_upsert_returns_merged_phases_and_span_tree(cluster):
+    c, cl, vecs = cluster
+    out = cl.upsert("db", "s", [
+        {"_id": f"p{i}", "v": vecs[i]} for i in range(20)
+    ], profile=True)
+    assert out["total"] == 20
+    prof = out["profile"]
+    assert prof["partition_count"] == 2
+    assert prof["merge_ms"] >= 0
+    assert sum(p["doc_count"] for p in prof["partitions"].values()) == 20
+    for p in prof["partitions"].values():
+        assert p["rpc_ms"] >= 0
+        # every raft/wal/engine phase of the documented schema, in ms
+        assert WRITE_PHASES <= set(p["phases"]), p["phases"]
+        assert p["phases"]["total"] >= 0
+        assert p["entries"] >= 1
+
+    # a profiled upsert is ALWAYS span-sampled: the span tree behind the
+    # numbers is pullable from /debug/traces on each role
+    tid = out["trace_id"]
+    assert tid
+    assert {"router.upsert", "router.scatter"} <= _span_names(
+        c.router_addr, tid)
+    ps_names: set[str] = set()
+    for ps in c.ps_nodes:
+        ps_names |= _span_names(ps.addr, tid)
+    assert {"ps.upsert", "raft.propose_wait", "wal.append",
+            "raft.commit_wait", "engine.apply"} <= ps_names, ps_names
+
+
+def test_background_build_is_observable_job(cluster):
+    c, cl, vecs = cluster
+    ps = c.ps_nodes[0]
+    pid = next(iter(ps.engines))
+    eng = ps.engines[pid]
+    # slow the assign phase down so the running state is observable
+    real_absorb = eng.indexes["v"].absorb
+
+    def slow_absorb(count):
+        time.sleep(0.6)
+        return real_absorb(count)
+
+    eng.indexes["v"].absorb = slow_absorb
+    try:
+        out = rpc.call(ps.addr, "POST", "/ps/index/build",
+                       {"partition_id": pid, "background": True})
+        assert out["background"] is True
+        # catch the job mid-flight: running, with progress denominators
+        running = None
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            jobs = rpc.call(ps.addr, "GET", "/ps/jobs")["jobs"]
+            mine = [j for j in jobs if j["partition_id"] == pid]
+            if mine and mine[0]["status"] == "running":
+                running = mine[0]
+                break
+            time.sleep(0.02)
+        assert running is not None, "build never observed running"
+        assert running["op"] == "build"
+        assert running["docs_total"] >= 1
+        assert running["docs_done"] <= running["docs_total"]
+        # internal keys (_phase_spans) never leak out of the API
+        assert not any(k.startswith("_") for k in running)
+    finally:
+        eng.indexes["v"].absorb = real_absorb
+
+    # ... and to its terminal state
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        jobs = rpc.call(ps.addr, "GET", "/ps/jobs")["jobs"]
+        mine = [j for j in jobs if j["partition_id"] == pid]
+        if mine and mine[0]["status"] != "running":
+            done = mine[0]
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("background build never reached a terminal state")
+    assert done["status"] == "done"
+    assert done["phase"] == "done"
+    assert done["error"] is None
+    assert done["duration_seconds"] >= 0
+    assert {"assign", "publish", "warmup"} <= set(done["phases_ms"])
+    assert done["docs_done"] == done["docs_total"]
+
+    # the progress gauge reports 1.0 for the built partition
+    page = _scrape(ps.addr)
+    assert (f'vearch_index_build_progress{{partition="{pid}"}} 1.0'
+            in page), page.splitlines()[:5]
+    # build phases were replayed as spans into the trace store
+    spans = _fetch_json(ps.addr, "/debug/traces")["spans"]
+    build_spans = {s["name"] for s in spans
+                   if s["name"].startswith("build.")}
+    assert {"build.assign", "build.publish", "build.warmup"} <= build_spans
+
+
+def test_write_side_metrics_render(cluster):
+    c, cl, vecs = cluster
+    # exercise the delete counter too
+    out = rpc.call(c.router_addr, "POST", "/document/delete", {
+        "db_name": "db", "space_name": "s", "document_ids": ["d0"]})
+    assert out["total"] >= 1
+    for ps in c.ps_nodes:
+        page = _scrape(ps.addr)
+        for name in (
+            'vearch_ps_write_docs_total',
+            'op="upsert"',
+            "vearch_wal_fsync_latency_seconds",
+            "vearch_wal_append_batch_entries",
+            "vearch_raft_apply_lag",
+            "vearch_ps_memory_used_bytes",
+            "vearch_index_build_progress",
+        ):
+            assert name in page, f"{ps.addr}: missing {name}"
+    # the delete hit whichever partition owns d0
+    assert any('op="delete"' in _scrape(ps.addr) for ps in c.ps_nodes)
+    # build-duration histogram exists on the node that ran the build
+    assert any("vearch_index_build_duration_seconds" in _scrape(ps.addr)
+               for ps in c.ps_nodes)
+
+
+def test_cluster_health_rolls_up_builds(cluster):
+    c, cl, vecs = cluster
+    # wait past a heartbeat for the PS to report its build state
+    deadline = time.time() + 12.0
+    annotated = None
+    while time.time() < deadline:
+        health = rpc.call(c.master_addr, "GET", "/cluster/health")
+        parts = [p for sp in health["spaces"] for p in sp["partitions"]]
+        tagged = [p for p in parts if p.get("build")]
+        if tagged:
+            annotated = (health, tagged)
+            break
+        time.sleep(0.25)
+    assert annotated is not None, \
+        "no partition carried a build annotation after heartbeats"
+    health, tagged = annotated
+    assert tagged[0]["build"] == "done"
+    assert health["builds_running"] == 0
+    assert health["builds_failed"] == 0

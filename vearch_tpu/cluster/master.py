@@ -935,8 +935,26 @@ class MasterServer:
     def _h_cluster_health(self, _body, _parts) -> dict:
         """Per-space health roll-up (reference: cluster_api.go health):
         green = every partition leader-alive and fully replicated,
-        yellow = serving but under-replicated, red = leaderless."""
+        yellow = serving but under-replicated, red = leaderless.
+
+        Also rolls up index-build job state from the heartbeat-fed
+        partition stats: partitions with a build in flight (or whose
+        last build failed) are annotated, and cluster-level
+        builds_running / builds_failed counts surface stuck or broken
+        background jobs without scraping every PS."""
+        fwd = self._leader_get("/cluster/health")
+        if fwd is not None:
+            return fwd
         servers = {s.node_id for s in self._alive_servers()}
+        # partition id -> build status, as last heartbeated by any node
+        # hosting it (leader wins when both report)
+        builds: dict[int, str] = {}
+        for nid, parts_stats in list(self._node_stats.items()):
+            for pid_s, st in dict(parts_stats).items():
+                bs = st.get("build_status")
+                if bs and (st.get("leader") or int(pid_s) not in builds):
+                    builds[int(pid_s)] = bs
+        builds_running = builds_failed = 0
         spaces = []
         worst = "green"
         rank = {"green": 0, "yellow": 1, "red": 2}
@@ -951,15 +969,25 @@ class MasterServer:
                     pstat = "yellow"
                 else:
                     pstat = "green"
-                parts.append({"id": p["id"], "status": pstat,
-                              "alive_replicas": len(alive)})
+                entry = {"id": p["id"], "status": pstat,
+                         "alive_replicas": len(alive)}
+                bs = builds.get(int(p["id"]))
+                if bs:
+                    entry["build"] = bs
+                    if bs == "running":
+                        builds_running += 1
+                    elif bs == "error":
+                        builds_failed += 1
+                parts.append(entry)
                 if rank[pstat] > rank[status]:
                     status = pstat
             spaces.append({"db_name": sp["db_name"], "name": sp["name"],
                            "status": status, "partitions": parts})
             if rank[status] > rank[worst]:
                 worst = status
-        return {"status": worst if spaces else "green", "spaces": spaces}
+        return {"status": worst if spaces else "green", "spaces": spaces,
+                "builds_running": builds_running,
+                "builds_failed": builds_failed}
 
     def _h_members(self, _body, _parts) -> dict:
         """Metadata-raft membership (reference: GET /members +

@@ -17,6 +17,11 @@ _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
 )
 
+# power-of-two buckets for count/size-shaped histograms (WAL batch
+# entries, docs per write) where the latency-shaped defaults would put
+# every sample in +Inf
+SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
 
 def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
     if not names:

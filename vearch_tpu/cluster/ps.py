@@ -28,9 +28,13 @@ from vearch_tpu.engine.engine import Engine, SearchRequest
 from vearch_tpu.engine.types import DataType, TableSchema
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Partition
-from vearch_tpu.cluster.metrics import register_tracer_metrics
+from vearch_tpu.cluster.metrics import SIZE_BUCKETS, register_tracer_metrics
 from vearch_tpu.cluster.raft import RaftNode
-from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+from vearch_tpu.cluster.rpc import (
+    ERR_REQUEST_KILLED,
+    JsonRpcServer,
+    RpcError,
+)
 from vearch_tpu.utils import log
 
 _log = log.get("ps")
@@ -71,6 +75,23 @@ def _profile_from_timing(timing: dict) -> dict:
         out["doc_count"] = timing["doc_count"]
     if "micro_batch_rows" in timing:
         out["micro_batch_rows"] = timing["micro_batch_rows"]
+    return out
+
+
+def _write_profile_from_timing(timing: dict) -> dict:
+    """Write-side profile=true breakdown: the raft proposal's phase
+    windows (propose-wait / wal append+fsync / commit-wait / apply),
+    shaped like the search profile so the router merges both the same
+    way (schema in docs/OBSERVABILITY.md)."""
+    out: dict = {
+        "phases": {
+            k[: -len("_ms")]: v for k, v in timing.items()
+            if k.endswith("_ms")
+        },
+    }
+    for k in ("doc_count", "entries"):
+        if k in timing:
+            out[k] = timing[k]
     return out
 
 
@@ -148,6 +169,20 @@ class PSServer:
         self._backup_jobs_lock = threading.Lock()
         self.slow_request_ms = 0
         self.killed_requests = 0
+        # per-request deadline default (ms); a search may override via
+        # its own deadline_ms option. 0 disables. Arms RequestContext so
+        # expiry aborts between dispatches (reference: the timeout the
+        # reference's rpcx layer enforces per handler).
+        self.request_deadline_ms = 0
+        # cached cross-engine memory accounting: _h_upsert used to
+        # re-sum memory_usage_bytes() over every engine per request —
+        # O(partitions) host walks on the hot write path. Applies mark
+        # the cache dirty; a dirty read refreshes at most every
+        # _mem_min_interval seconds, a clean one every _mem_max_age.
+        self._mem_cache: tuple[float, int] = (0.0, 0)
+        self._mem_dirty = True
+        self._mem_min_interval = 0.02
+        self._mem_max_age = 5.0
         # slow-query isolation (reference: dedicated slow-search channel
         # pool, ps/server.go:95 + engine slow_search_time marking): each
         # partition keeps an EWMA of its search latency; partitions
@@ -161,12 +196,15 @@ class PSServer:
         self._search_ewma: dict[int, float] = {}  # pid -> ms
         self.slow_routed = 0
 
-        from vearch_tpu.cluster.tracing import Tracer
+        from vearch_tpu.cluster.tracing import NULL_SPAN, SlowLog, Tracer
 
         # spans join the router's trace via the _trace_ctx envelope
         # (reference: PS extracts span context from rpcx metadata,
         # ps/handler_document.go:123-126)
         self.tracer = Tracer("ps", collector_endpoint=trace_collector)
+        # slow/killed request ring at GET /debug/slowlog; threshold via
+        # /ps/engine/config {"slow_log_ms": ...}
+        self.slowlog = SlowLog()
 
         self.server = JsonRpcServer(host, port)
         self.server.tracer = self.tracer
@@ -190,6 +228,8 @@ class PSServer:
         s.route("GET", "/ps/stats", self._h_stats)
         s.route("POST", "/ps/kill", self._h_kill)
         s.route("GET", "/ps/requests", self._h_requests)
+        s.route("GET", "/ps/jobs", self._h_jobs)
+        s.route("GET", "/debug/slowlog", self._h_slowlog)
         # raft transport (reference: raftstore/server.go heartbeat +
         # replicate ports; here routes on the one RPC server)
         s.route("POST", "/ps/raft/append", self._h_raft_append)
@@ -225,6 +265,61 @@ class PSServer:
         m.callback_gauge("vearch_ps_partitions",
                          "partitions hosted on this node", (),
                          lambda: {(): float(len(self.engines))})
+        m.callback_gauge("vearch_ps_memory_used_bytes",
+                         "engine memory across all partitions "
+                         "(cached accounting, feeds the write limit)",
+                         (),
+                         lambda: {(): float(self.memory_used_bytes())})
+
+        # write path (tentpole: ingest observability symmetric with the
+        # read path) — throughput counters per partition, kill counters
+        # by reason, WAL durability histograms fed by the Wal observer
+        self._write_docs_total = m.counter(
+            "vearch_ps_write_docs_total",
+            "documents written per partition (op: upsert/delete)",
+            ("partition", "op"))
+        self._killed_total = m.counter(
+            "vearch_requests_killed_total",
+            "in-flight requests aborted, by reason "
+            "(deadline/slow/operator)",
+            ("reason",))
+        self._wal_fsync_hist = m.histogram(
+            "vearch_wal_fsync_latency_seconds",
+            "WAL fsync wall time per append batch",
+            ("partition",))
+        self._wal_batch_hist = m.histogram(
+            "vearch_wal_append_batch_entries",
+            "log entries per WAL append batch",
+            ("partition",), buckets=SIZE_BUCKETS)
+
+        # index-build jobs (tentpole: background-job telemetry)
+        self._build_hist = m.histogram(
+            "vearch_index_build_duration_seconds",
+            "index build wall time (op: build/rebuild)",
+            ("partition", "op"),
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+
+        def _build_progress():
+            # one series per hosted partition regardless of job state:
+            # 0.0 before any build, fraction while running, 1.0 done —
+            # a mid-soak build must not mint a new series
+            out = {}
+            for pid, eng in list(self.engines.items()):
+                job = getattr(eng, "build_job", None)
+                if job is None:
+                    out[(str(pid),)] = 0.0
+                else:
+                    total = max(int(job.get("docs_total") or 0), 1)
+                    frac = float(job.get("docs_done", 0)) / total
+                    if job.get("status") in ("done", "error"):
+                        frac = 1.0
+                    out[(str(pid),)] = min(frac, 1.0)
+            return out
+
+        m.callback_gauge("vearch_index_build_progress",
+                         "docs processed / total for the current or "
+                         "last index build",
+                         ("partition",), _build_progress)
 
         # raft replication observability (tentpole: VERDICT weak #2 was
         # undiagnosable because raft exposed no lag/latency/election
@@ -272,6 +367,11 @@ class PSServer:
         m.callback_gauge("vearch_raft_applied_index",
                          "raft applied index", ("partition",),
                          _per_node(lambda n: n.applied))
+        m.callback_gauge("vearch_raft_apply_lag",
+                         "committed-but-unapplied entries "
+                         "(commit - applied)", ("partition",),
+                         _per_node(
+                             lambda n: max(n.commit - n.applied, 0)))
         m.callback_gauge("vearch_raft_term",
                          "raft term", ("partition",),
                          _per_node(lambda n: n.term))
@@ -374,6 +474,7 @@ class PSServer:
         out = {}
         for pid, eng in list(self.engines.items()):
             try:
+                job = eng.build_job
                 out[str(pid)] = {
                     "doc_count": eng.doc_count,
                     "size_bytes": eng.memory_usage_bytes(),
@@ -382,6 +483,10 @@ class PSServer:
                         bool(self.raft_nodes[pid].state().get("is_leader"))
                         if pid in self.raft_nodes else True
                     ),
+                    # index-build job state rides the heartbeat so the
+                    # master's /cluster/health can roll up in-flight and
+                    # failed builds cluster-wide
+                    "build_status": job.get("status") if job else None,
                 }
             except Exception:
                 continue
@@ -483,6 +588,7 @@ class PSServer:
                     self._persist_partition_meta(part)
                 eng = Engine.open(pdir)
                 eng.start_refresh_loop()
+                self._wire_engine(pid, eng)
                 applied = 0
                 ap = os.path.join(pdir, "applied.json")
                 if os.path.exists(ap):
@@ -508,7 +614,7 @@ class PSServer:
     def _make_raft_node(self, part: Partition, pdir: str) -> RaftNode:
         pid = part.id
         members = part.replicas or [self.node_id or 0]
-        return RaftNode(
+        node = RaftNode(
             pid=pid,
             node_id=self.node_id if self.node_id is not None else 0,
             wal_dir=os.path.join(pdir, "raft"),
@@ -525,6 +631,22 @@ class PSServer:
                 _pid, data, idx),
             observer=self._raft_observer(pid),
         )
+        node.wal.observer = self._wal_observer(pid)
+        return node
+
+    def _wal_observer(self, pid: int):
+        """WAL event sink feeding the durability histograms: fsync
+        latency tells you when the disk (not the quorum) is the write
+        bottleneck; batch entries show whether group-commit batching is
+        actually happening. Fires under the WAL lock — keep it cheap."""
+
+        def observe(event: str, info: dict) -> None:
+            if event == "append":
+                self._wal_fsync_hist.observe(
+                    float(info.get("fsync_seconds", 0.0)), str(pid))
+                self._wal_batch_hist.observe(
+                    float(info.get("entries", 0)), str(pid))
+        return observe
 
     def _raft_observer(self, pid: int):
         """Raft event sink: latency events feed the /metrics histograms;
@@ -553,6 +675,7 @@ class PSServer:
         eng = self._engine(pid)
         t = op["type"]
         if t == "upsert":
+            self._mem_dirty = True  # cached memory accounting is stale
             try:
                 return eng.upsert(op["documents"])
             except ValueError as e:
@@ -564,6 +687,7 @@ class PSServer:
                 # marker on every replica, so determinism holds.
                 return {"_rejected": str(e)}
         if t == "delete":
+            self._mem_dirty = True
             return eng.delete(op["keys"])
         raise RpcError(500, f"unknown log op {t!r}")
 
@@ -731,9 +855,11 @@ class PSServer:
             tar.extractall(pdir, filter="data")
         eng = Engine.open(pdir)
         eng.start_refresh_loop()
+        self._wire_engine(pid, eng)
         with self._lock:
             self.engines[pid] = eng
         self._flushed[pid] = snap_index
+        self._mem_dirty = True
 
     # -- handlers ------------------------------------------------------------
 
@@ -742,6 +868,35 @@ class PSServer:
         if eng is None:
             raise RpcError(404, f"partition {pid} not on this node")
         return eng
+
+    def memory_used_bytes(self) -> int:
+        """Total engine memory across partitions, from a short-TTL /
+        dirty-flag cache: a clean read serves the cached sum for up to
+        _mem_max_age seconds; applies mark it dirty, and a dirty read
+        refreshes at most every _mem_min_interval seconds so a write
+        burst pays one O(engines) walk per interval, not per request."""
+        now = time.time()
+        ts, val = self._mem_cache
+        age = now - ts
+        if (age > self._mem_max_age
+                or (self._mem_dirty and age > self._mem_min_interval)):
+            val = sum(
+                e.memory_usage_bytes() for e in list(self.engines.values())
+            )
+            self._mem_cache = (now, val)
+            self._mem_dirty = False
+        return val
+
+    def _wire_engine(self, pid: int, eng: Engine) -> None:
+        """Attach the per-engine observability hooks every creation
+        path (create / recover / snapshot install / restore) needs:
+        terminal build states feed the build-duration histogram — this
+        covers background auto-builds the request handlers never see."""
+        def on_build_done(job: dict, _pid: int = pid) -> None:
+            self._build_hist.observe(
+                float(job.get("duration_seconds") or 0.0),
+                str(_pid), str(job.get("op", "build")))
+        eng.build_observer = on_build_done
 
     def _h_create_partition(self, body: dict, _parts) -> dict:
         part = Partition.from_dict(body["partition"])
@@ -754,6 +909,7 @@ class PSServer:
             eng = Engine(schema, data_dir=pdir)
             eng.dump()  # schema on disk immediately: crash-openable
             eng.start_refresh_loop()
+            self._wire_engine(pid, eng)
             self.engines[pid] = eng
             self.partitions[pid] = part
             self._persist_partition_meta(part)
@@ -785,12 +941,14 @@ class PSServer:
     def _h_upsert(self, body: dict, _parts) -> dict:
         import uuid
 
+        from vearch_tpu.cluster.tracing import NULL_SPAN
+
         pid = int(body["partition_id"])
         self._engine(pid)  # 404 before proposing
         if self.memory_limit_mb:
-            used = sum(
-                e.memory_usage_bytes() for e in self.engines.values()
-            ) >> 20
+            # cached accounting: the old inline sum walked every engine
+            # on EVERY upsert — O(partitions) per request
+            used = self.memory_used_bytes() >> 20
             if used >= self.memory_limit_mb:
                 raise RpcError(
                     403,
@@ -829,20 +987,75 @@ class PSServer:
                 )
             if not missing:
                 batch_ids.add(str(doc["_id"]))
-        keys = self._node(pid).propose([{"type": "upsert",
-                                         "documents": docs}])[0]
+        tctx = body.get("_trace_ctx")
+        profile = bool(body.get("profile"))
+        # write-side timing mirrors the search path: raft fills per-phase
+        # windows (propose-wait / wal append+fsync / commit-wait / apply)
+        # which become child spans and the profile:true breakdown
+        timing: dict | None = {} if (profile or tctx) else None
+        span = (
+            self.tracer.span("ps.upsert", ctx=tctx,
+                             tags={"partition": pid, "node": self.node_id,
+                                   "docs": len(docs)})
+            if tctx else NULL_SPAN
+        )
+        with span:
+            keys = self._node(pid).propose(
+                [{"type": "upsert", "documents": docs}], timing=timing)[0]
+            if timing is not None:
+                timing["doc_count"] = len(docs)
+                self._replay_write_spans(span, timing, pid)
         if isinstance(keys, dict) and "_rejected" in keys:
             raise RpcError(400, keys["_rejected"])
-        return {"keys": keys, "count": len(keys)}
+        self._write_docs_total.inc(str(pid), "upsert", by=float(len(docs)))
+        out = {"keys": keys, "count": len(keys)}
+        if profile:
+            out["profile"] = _write_profile_from_timing(timing or {})
+        return out
+
+    def _replay_write_spans(self, span, timing: dict, pid: int) -> None:
+        """Replay raft's measured phase windows as child spans under the
+        sampled ps.upsert/ps.delete span, and tag the parent with the
+        flat `*_ms` breakdown (same contract as the search path)."""
+        from vearch_tpu.cluster.tracing import NULL_SPAN
+
+        pspans = timing.pop("_phase_spans", None) or []
+        if span is NULL_SPAN:
+            return
+        sctx = span.ctx()
+        for name, start_us, dur_us in pspans:
+            self.tracer.record(name, ctx=sctx, start_us=start_us,
+                               dur_us=dur_us, tags={"partition": pid})
+        for phase, ms in timing.items():
+            span.set_tag(phase, ms)
 
     def _h_delete(self, body: dict, _parts) -> dict:
+        from vearch_tpu.cluster.tracing import NULL_SPAN
+
         pid = int(body["partition_id"])
         eng = self._engine(pid)
         node = self._node(pid)
+        tctx = body.get("_trace_ctx")
+        profile = bool(body.get("profile"))
+        span = (
+            self.tracer.span("ps.delete", ctx=tctx,
+                             tags={"partition": pid, "node": self.node_id})
+            if tctx else NULL_SPAN
+        )
         if body.get("keys"):
-            deleted = node.propose([{"type": "delete",
-                                     "keys": body["keys"]}])[0]
-            return {"deleted": deleted}
+            timing: dict | None = {} if (profile or tctx) else None
+            with span:
+                deleted = node.propose(
+                    [{"type": "delete", "keys": body["keys"]}],
+                    timing=timing)[0]
+                if timing is not None:
+                    self._replay_write_spans(span, timing, pid)
+            self._write_docs_total.inc(str(pid), "delete",
+                                       by=float(deleted or 0))
+            out = {"deleted": deleted}
+            if profile:
+                out["profile"] = _write_profile_from_timing(timing or {})
+            return out
         # delete-by-filter (reference: /document/delete with filters).
         # Drain in batches until no matches remain — a single capped
         # query would silently delete only the first 10k of a larger
@@ -863,6 +1076,7 @@ class PSServer:
             deleted += node.propose([{"type": "delete", "keys": keys}])[0]
             if len(docs) < want:
                 break
+        self._write_docs_total.inc(str(pid), "delete", by=float(deleted))
         return {"deleted": deleted}
 
     def _h_get(self, body: dict, _parts) -> dict:
@@ -882,15 +1096,24 @@ class PSServer:
             time.sleep(max(0.05, min(0.5,
                                      (self.slow_request_ms or 2000) / 4000.0)))
             limit = self.slow_request_ms
-            if not limit:
-                continue
             now = time.time()
             with self._inflight_lock:
                 for rid, info in self._inflight.items():
-                    if (now - info["start"]) * 1e3 > limit and \
-                            not info["ctx"].killed:
-                        info["ctx"].kill(
-                            f"slow request killed after {limit}ms"
+                    ctx = info["ctx"]
+                    if ctx.killed:
+                        continue
+                    # per-request deadlines arm even when the slow-killer
+                    # limit is off; ctx.check() also self-enforces them
+                    # between dispatches, this loop just makes the kill
+                    # prompt for requests parked off-device
+                    dl = info.get("deadline")
+                    if dl is not None and now > dl:
+                        ctx.kill("deadline exceeded", code="deadline")
+                        self.killed_requests += 1
+                    elif limit and (now - info["start"]) * 1e3 > limit:
+                        ctx.kill(
+                            f"slow request killed after {limit}ms",
+                            code="slow",
                         )
                         self.killed_requests += 1
 
@@ -904,7 +1127,7 @@ class PSServer:
         with self._inflight_lock:
             for info in self._inflight.values():
                 if info["rid"] == rid and not info["ctx"].killed:
-                    info["ctx"].kill("killed by operator")
+                    info["ctx"].kill("killed by operator", code="operator")
                     killed += 1
             self.killed_requests += killed
         if not killed:
@@ -973,11 +1196,20 @@ class PSServer:
         gate_wait_ms = round((time.time() - t_gate) * 1e3, 3)
         rid = str(body.get("request_id") or uuid.uuid4().hex)
         token = uuid.uuid4().hex  # unique even when clients reuse rids
-        ctx = RequestContext(rid)
+        # per-request deadline: the search option wins, else the PS-wide
+        # config default; 0/absent leaves the request unbounded
+        deadline_ms = float(
+            body.get("deadline_ms") or self.request_deadline_ms or 0
+        )
         t_start = time.time()
+        ctx = RequestContext(
+            rid,
+            deadline=(t_start + deadline_ms / 1e3) if deadline_ms else None,
+        )
         with self._inflight_lock:
             self._inflight[token] = {"rid": rid, "start": t_start,
-                                     "ctx": ctx, "slow": slow}
+                                     "ctx": ctx, "slow": slow,
+                                     "deadline": ctx.deadline}
         from vearch_tpu.cluster.tracing import NULL_SPAN
 
         tctx = body.get("_trace_ctx")
@@ -987,9 +1219,18 @@ class PSServer:
                                    "slow_channel": slow})
             if tctx else NULL_SPAN
         )
+        want_trace = bool(body.get("trace") or body.get("profile"))
+        # slowlog/deadline observability needs the phase breakdown even
+        # when the client didn't ask for one — force the engine trace on
+        # so a killed or slow request can explain where its time went
+        # (the dict is stripped from the response below unless asked for)
+        trace: dict | None = (
+            {} if (want_trace or ctx.deadline is not None
+                   or self.slowlog.threshold_ms > 0) else None
+        )
         try:
             with span:
-                out = self._do_search(eng, body, vectors, ctx)
+                out = self._do_search(eng, body, vectors, ctx, trace)
                 timing = out.get("timing")
                 if timing is not None:
                     timing["gate_wait_ms"] = gate_wait_ms
@@ -1014,9 +1255,29 @@ class PSServer:
                         span.set_tag(phase, ms)
                 if body.get("profile"):
                     out["profile"] = _profile_from_timing(timing or {})
+                if not want_trace:
+                    # forced-on timing is internal observability, not
+                    # part of the client's response contract
+                    out.pop("timing", None)
                 return out
         except RequestKilled as e:
-            raise RpcError(408, f"request {rid}: {e}") from e
+            reason = ctx.reason_code or "operator"
+            self._killed_total.inc(reason)
+            # force-sample killed requests: even an untraced request
+            # leaves a span in /debug/traces explaining the abort
+            if span is NULL_SPAN:
+                self.tracer.record(
+                    "ps.search",
+                    start_us=int(t_start * 1e6),
+                    dur_us=int((time.time() - t_start) * 1e6),
+                    tags={"partition": pid, "request_id": rid,
+                          "kill_reason": reason},
+                    status="error: RequestKilled",
+                )
+            # terminal abort code — the router must NOT retry this as a
+            # failover (the kill exists to shed this exact work)
+            raise RpcError(ERR_REQUEST_KILLED,
+                           f"request_killed: request {rid}: {e}") from e
         finally:
             with self._inflight_lock:
                 self._inflight.pop(token, None)
@@ -1026,11 +1287,20 @@ class PSServer:
             ms = (time.time() - t_start) * 1e3
             prev = self._search_ewma.get(pid, ms)
             self._search_ewma[pid] = 0.8 * prev + 0.2 * ms
+            if self.slowlog.should_log(ms, killed=ctx.killed):
+                t = trace or {}
+                self.slowlog.add({
+                    "request_id": rid, "partition": pid, "op": "search",
+                    "elapsed_ms": round(ms, 3),
+                    "killed": ctx.killed, "reason": ctx.reason,
+                    "phases": {k[:-len("_ms")]: v for k, v in t.items()
+                               if k.endswith("_ms")},
+                    "dispatches": t.get("dispatches"),
+                    "trace_id": span.trace_id or None,
+                })
 
-    def _do_search(self, eng, body, vectors, ctx=None) -> dict:
-        # profile implies timing: the explain surface needs the engine's
-        # phase breakdown even when the client didn't ask for a trace
-        trace = {} if (body.get("trace") or body.get("profile")) else None
+    def _do_search(self, eng, body, vectors, ctx=None,
+                   trace: dict | None = None) -> dict:
         columnar = bool(
             body.get("columnar_wire") and body.get("include_fields") == []
         )
@@ -1123,9 +1393,58 @@ class PSServer:
         return {"documents": docs}
 
     def _h_build(self, body: dict, _parts) -> dict:
-        eng = self._engine(body["partition_id"])
-        eng.build_index()
+        pid = int(body["partition_id"])
+        eng = self._engine(pid)
+        if body.get("background"):
+            # observable job mode: return immediately, progress and the
+            # terminal state are readable at GET /ps/jobs
+            threading.Thread(
+                target=self._run_build, args=(pid, eng, False),
+                daemon=True, name=f"build-p{pid}",
+            ).start()
+            return {"partition_id": pid, "status": int(eng.status),
+                    "background": True}
+        self._run_build(pid, eng, False)
         return {"status": int(eng.status)}
+
+    def _run_build(self, pid: int, eng: Engine, rebuild: bool) -> None:
+        """Run a build/rebuild and replay its phase windows (train /
+        assign / publish / warmup) as spans, so /debug/traces shows the
+        job next to the searches it competed with."""
+        job = None
+        try:
+            if rebuild:
+                eng.rebuild_index()
+            else:
+                eng.build_index()
+        finally:
+            job = eng.build_job
+            if job is not None:
+                op = str(job.get("op", "build"))
+                for name, start_us, dur_us in job.get("_phase_spans") or []:
+                    self.tracer.record(
+                        name, start_us=start_us, dur_us=dur_us,
+                        tags={"partition": pid, "op": op},
+                    )
+
+    def _h_jobs(self, _body, _parts) -> dict:
+        """Index-build job registry: one entry per partition that has
+        run (or is running) a build since process start. Internal keys
+        (the `_phase_spans` replay rows) are stripped."""
+        jobs = []
+        for pid, eng in sorted(self.engines.items()):
+            job = eng.build_job
+            if job is None:
+                continue
+            jobs.append({
+                "partition_id": pid,
+                **{k: v for k, v in job.items() if not k.startswith("_")},
+            })
+        return {"jobs": jobs}
+
+    def _h_slowlog(self, _body, _parts) -> dict:
+        return {"threshold_ms": self.slowlog.threshold_ms,
+                "entries": self.slowlog.entries()}
 
     def _h_field_index(self, body: dict, _parts) -> dict:
         """Master fan-out target for online scalar field-index add/remove
@@ -1159,8 +1478,16 @@ class PSServer:
         return {"added": added}
 
     def _h_rebuild(self, body: dict, _parts) -> dict:
-        eng = self._engine(body["partition_id"])
-        eng.rebuild_index()
+        pid = int(body["partition_id"])
+        eng = self._engine(pid)
+        if body.get("background"):
+            threading.Thread(
+                target=self._run_build, args=(pid, eng, True),
+                daemon=True, name=f"rebuild-p{pid}",
+            ).start()
+            return {"partition_id": pid, "status": int(eng.status),
+                    "background": True}
+        self._run_build(pid, eng, True)
         return {"status": int(eng.status)}
 
     def _h_flush(self, body: dict, _parts) -> dict:
@@ -1186,6 +1513,14 @@ class PSServer:
         if "slow_route_ms" in cfg:
             # reference: slow-channel isolation threshold (ps/server.go:95)
             self.slow_route_ms = int(cfg["slow_route_ms"])
+        if "slow_log_ms" in cfg:
+            # slow-query log capture threshold (<=0 disables); killed
+            # requests are force-logged regardless
+            self.slowlog.threshold_ms = float(cfg["slow_log_ms"])
+        if "request_deadline_ms" in cfg:
+            # default per-request deadline; a search's own deadline_ms
+            # option overrides it per request
+            self.request_deadline_ms = int(cfg["request_deadline_ms"])
         if "log_level" in cfg:
             # runtime log-level flip, fanned out by the master's /config
             # (reference: log-level runtime config in pkg/log)
@@ -1336,8 +1671,10 @@ class PSServer:
                                os.path.join(data_dir, name))
                 restored = Engine.open(data_dir)
                 restored.start_refresh_loop()
+                self._wire_engine(pid, restored)
                 with self._lock:
                     self.engines[pid] = restored
+                self._mem_dirty = True
                 # restored state supersedes the log: reset it at the
                 # current applied horizon (a point-in-time rewind).
                 # last_term is the term AT last_index, so the horizon

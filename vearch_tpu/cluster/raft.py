@@ -205,12 +205,23 @@ class RaftNode:
 
     # -- leader: propose + replicate -----------------------------------------
 
-    def propose(self, ops: list[dict]) -> list[Any]:
+    def propose(self, ops: list[dict],
+                timing: dict | None = None) -> list[Any]:
         """Append ops, replicate to a quorum, commit, apply. Returns the
         apply results in op order. Raises 421 when not leader, 503 when
         a quorum cannot be assembled in time (the entries stay in the
-        log and may commit later — at-least-once, ops are idempotent)."""
+        log and may commit later — at-least-once, ops are idempotent).
+
+        When `timing` is a dict the per-phase wall windows land in it
+        (`propose_wait_ms` / `wal_append_ms` / `commit_wait_ms` /
+        `apply_ms` / `total_ms` + `_phase_spans` rows) — the write-side
+        analogue of the engine's trace dict, replayed by the PS as child
+        spans under ps.upsert / ps.delete."""
+        t_enter = time.time()
         with self._propose_lock:
+            # serialized proposals queue on _propose_lock: the wait here
+            # is the write-side analogue of the search gate wait
+            t_lock = time.time()
             with self._lock:
                 if self._stopped:
                     raise RpcError(503, f"partition {self.pid}: stopped")
@@ -222,9 +233,10 @@ class RaftNode:
                     {"index": start + i, "term": term, "op": op}
                     for i, op in enumerate(ops)
                 ]
+                t_wal = time.time()
                 self.wal.append(entries, fsync=True)
+                t_append = time.time()
                 target = entries[-1]["index"]
-            t_append = time.time()
             self._replicate_and_wait(target)
             with self._lock:
                 if self.commit < target:
@@ -233,13 +245,15 @@ class RaftNode:
                         f"partition {self.pid}: no quorum for index "
                         f"{target} within {self.quorum_timeout}s",
                     )
+            t_commit = time.time()
             # append -> quorum-commit wall time (the replication RTT the
             # client write waited for)
             self._observe("commit", {
-                "seconds": time.time() - t_append, "index": target,
+                "seconds": t_commit - t_append, "index": target,
                 "entries": len(entries),
             })
             self._apply_to_commit()
+            t_apply = time.time()
             # push the advanced commit index to followers synchronously
             # so they apply before the client sees the ack — follower
             # reads (load_balance random/not_leader) then serve the
@@ -247,6 +261,27 @@ class RaftNode:
             # replica visibility expectations. Best-effort: a straggler
             # catches up on the next tick.
             self._notify_commit()
+            if timing is not None:
+                spans = []
+                spans.append(["raft.propose_wait", int(t_enter * 1e6),
+                              int((t_lock - t_enter) * 1e6)])
+                spans.append(["wal.append", int(t_wal * 1e6),
+                              int((t_append - t_wal) * 1e6)])
+                spans.append(["raft.commit_wait", int(t_append * 1e6),
+                              int((t_commit - t_append) * 1e6)])
+                spans.append(["engine.apply", int(t_commit * 1e6),
+                              int((t_apply - t_commit) * 1e6)])
+                timing["propose_wait_ms"] = round(
+                    (t_lock - t_enter) * 1e3, 3)
+                timing["wal_append_ms"] = round(
+                    (t_append - t_wal) * 1e3, 3)
+                timing["commit_wait_ms"] = round(
+                    (t_commit - t_append) * 1e3, 3)
+                timing["apply_ms"] = round((t_apply - t_commit) * 1e3, 3)
+                timing["total_ms"] = round(
+                    (time.time() - t_enter) * 1e3, 3)
+                timing["entries"] = len(entries)
+                timing["_phase_spans"] = spans
             with self._lock:
                 return [self._apply_results[e["index"]] for e in entries]
 

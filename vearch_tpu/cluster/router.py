@@ -19,7 +19,7 @@ from typing import Any
 
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Server, Space
-from vearch_tpu.cluster.rpc import JsonRpcServer, RpcError
+from vearch_tpu.cluster.rpc import ERR_REQUEST_KILLED, JsonRpcServer, RpcError
 
 SPACE_CACHE_TTL = 3.0
 
@@ -37,9 +37,12 @@ class RouterServer:
         trace_collector: str | None = None,
         grpc_port: int | None = None,
     ):
-        from vearch_tpu.cluster.tracing import Tracer
+        from vearch_tpu.cluster.tracing import SlowLog, Tracer
 
         self.master_addr = master_addr
+        # per-role slow-query ring (threshold settable at runtime);
+        # killed requests are force-recorded regardless of threshold
+        self.slowlog = SlowLog()
         # span tracer (reference: Jaeger init, startup.go:66; sampler
         # rate + collector endpoint from the [tracer] config block)
         self.tracer = Tracer("router", sample_rate=trace_sample,
@@ -94,6 +97,7 @@ class RouterServer:
         s.route("GET", "/cache/dbs", self._h_cache_space)
         s.route("GET", "/cluster/health", self._h_health)
         s.route("GET", "/router/stats", self._h_router_stats)
+        s.route("GET", "/debug/slowlog", self._h_slowlog)
         s.tracer = self.tracer  # serves GET /debug/traces
         from vearch_tpu.cluster.metrics import register_tracer_metrics
 
@@ -311,6 +315,9 @@ class RouterServer:
         # All mean the cluster is mid-failover: refresh metadata and
         # retry with backoff until the master finishes promoting
         # (reference: client.go:433-447 replica failover retry loop).
+        # 499 (ERR_REQUEST_KILLED) deliberately falls through the
+        # whitelist below: a deadline/operator kill is terminal, and a
+        # retry would re-run the exact work the kill was meant to shed.
         last: RpcError | None = None
         for attempt in range(6):
             if attempt:
@@ -535,18 +542,75 @@ class RouterServer:
         self._validate_docs(space, body["documents"])
         by_partition = self._route_docs(space, body["documents"])
 
-        def send(pid: int, docs: list[dict]):
-            return self._call_partition(skey, pid, "/ps/doc/upsert",
-                                        {"documents": docs})
+        from vearch_tpu.cluster.tracing import NULL_SPAN
 
-        futures = [
-            self._pool.submit(send, pid, docs)
-            for pid, docs in by_partition.items()
-        ]
-        keys: list[str] = []
-        for f in futures:
-            keys.extend(f.result()["keys"])
-        return {"total": len(keys), "document_ids": keys}
+        profile = bool(body.get("profile", False))
+        # writes are orders of magnitude rarer than reads: a profiled
+        # upsert always gets its span tree (the acceptance surface for
+        # the write path), not just an explicitly traced one
+        explicit_trace = bool(body.get("trace", False)) or profile
+        sub = {"profile": profile}
+        # write-side root span, symmetric with router.search: scatter
+        # children carry _trace_ctx so each PS nests its ps.upsert (and
+        # the raft propose/wal/commit/apply phases) under this tree
+        root = (
+            self.tracer.span(
+                "router.upsert",
+                tags={"db": skey[0], "space": skey[1],
+                      "docs": len(body["documents"]),
+                      "partitions": len(by_partition)},
+            )
+            if self.tracer.should_sample(explicit_trace)
+            else NULL_SPAN
+        )
+        with root:
+            def send(pid: int, docs: list[dict]):
+                t0 = time.time()
+                if root.ctx() is not None:
+                    span = self.tracer.span(
+                        "router.scatter", ctx=root.ctx(),
+                        tags={"partition": pid, "op": "upsert"},
+                    )
+                    body_p = {**sub, "documents": docs,
+                              "_trace_ctx": span.ctx()}
+                else:
+                    span = NULL_SPAN
+                    body_p = {**sub, "documents": docs}
+                with span:
+                    r = self._call_partition(skey, pid, "/ps/doc/upsert",
+                                             body_p)
+                r["_rpc_ms"] = round((time.time() - t0) * 1e3, 3)
+                return pid, r
+
+            futures = [
+                self._pool.submit(send, pid, docs)
+                for pid, docs in by_partition.items()
+            ]
+            results = [f.result() for f in futures]
+            t_merge = time.time()
+            keys: list[str] = []
+            for _, r in results:
+                keys.extend(r["keys"])
+            out: dict = {"total": len(keys), "document_ids": keys}
+            if root.trace_id:
+                out["trace_id"] = root.trace_id
+            if profile:
+                # same merged shape as the search profile, so one
+                # client-side renderer covers both paths
+                out["profile"] = {
+                    "partitions": {
+                        str(pid): {"rpc_ms": r["_rpc_ms"],
+                                   **(r.get("profile") or {})}
+                        for pid, r in results
+                    },
+                    "merge_ms": round((time.time() - t_merge) * 1e3, 3),
+                    "partition_count": len(results),
+                }
+            return out
+
+    def _h_slowlog(self, _body, _parts) -> dict:
+        return {"threshold_ms": self.slowlog.threshold_ms,
+                "entries": self.slowlog.entries()}
 
     def _validate_docs(self, space: Space, docs: list[dict]) -> None:
         """Schema validation at the router (reference: doc_parse.go —
@@ -657,6 +721,38 @@ class RouterServer:
         return 0, k
 
     def _h_search(self, body: dict, _parts) -> dict:
+        t0 = time.time()
+        out: dict | None = None
+        killed = False
+        try:
+            out = self._search_impl(body)
+            return out
+        except RpcError as e:
+            # a killed request (deadline/slow/operator) is terminal —
+            # it still must leave a slowlog record at this role
+            killed = e.code == ERR_REQUEST_KILLED
+            raise
+        finally:
+            ms = (time.time() - t0) * 1e3
+            if self.slowlog.should_log(ms, killed=killed):
+                entry = {
+                    "op": "search",
+                    "db_name": body.get("db_name"),
+                    "space_name": body.get("space_name"),
+                    "request_id": body.get("request_id"),
+                    "elapsed_ms": round(ms, 3),
+                    "killed": killed,
+                }
+                if out is not None:
+                    if out.get("trace_id"):
+                        entry["trace_id"] = out["trace_id"]
+                    prof = out.get("profile")
+                    if prof:
+                        entry["partitions"] = prof.get("partitions")
+                        entry["merge_ms"] = prof.get("merge_ms")
+                self.slowlog.add(entry)
+
+    def _search_impl(self, body: dict) -> dict:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
         vectors, score_bounds = self._parse_vectors(space, body)
@@ -690,6 +786,10 @@ class RouterServer:
             # per-dispatch breakdown, merged below (the Elasticsearch-
             # profile / EXPLAIN analogue)
             "profile": bool(body.get("profile", False)),
+            # per-request deadline: each PS arms RequestContext.kill
+            # between dispatches; an expired request comes back as a
+            # terminal request_killed error (never retried)
+            "deadline_ms": body.get("deadline_ms"),
             "field_weights": {
                 r["field"]: r["weight"]
                 for r in body.get("ranker", {}).get("params", [])

@@ -34,6 +34,14 @@ _log = log.get("rpc")
 
 JSON_CT = "application/json"
 
+# Terminal per-request abort (deadline exceeded, slow-request killer,
+# operator /ps/kill). Distinct from the transient failover codes
+# (-1/421/503) so the router NEVER retries a killed request as if the
+# cluster were mid-failover — retrying would re-run the exact work the
+# kill was meant to shed. 499 follows the nginx "client closed request"
+# convention.
+ERR_REQUEST_KILLED = 499
+
 # Per-request context (the server is a ThreadingHTTPServer: one thread
 # per in-flight request). Handlers that make secondary RPCs on behalf of
 # the caller — e.g. a master follower forwarding a GET to the meta

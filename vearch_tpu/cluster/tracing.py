@@ -271,6 +271,44 @@ class Tracer:
         return items[-limit:]
 
 
+class SlowLog:
+    """Per-role bounded ring of slow-request records, served at
+    `GET /debug/slowlog` (reference: the PS slow-request marking around
+    engine slow_search_time + the router access log's slow entries —
+    here a structured ring instead of grep-able text).
+
+    `threshold_ms <= 0` disables slow capture; killed requests are
+    force-recorded regardless (a request the operator or a deadline had
+    to abort is exactly what the slowlog exists to explain). Entries
+    carry the PR-2 phase breakdown when the role has one in hand —
+    schema in docs/OBSERVABILITY.md."""
+
+    def __init__(self, maxlen: int = 256, threshold_ms: float = 0.0):
+        self.threshold_ms = float(threshold_ms)
+        self._entries: deque[dict] = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def should_log(self, elapsed_ms: float, killed: bool = False) -> bool:
+        return killed or (
+            self.threshold_ms > 0 and elapsed_ms > self.threshold_ms
+        )
+
+    def add(self, entry: dict) -> None:
+        e = dict(entry)
+        e.setdefault("ts", time.time())
+        with self._lock:
+            self._entries.append(e)
+
+    def entries(self, limit: int = 100) -> list[dict]:
+        with self._lock:
+            items = list(self._entries)
+        return items[-max(int(limit), 0):]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 class NullSpan:
     """No-op stand-in so call sites stay branch-free."""
 

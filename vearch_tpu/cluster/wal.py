@@ -20,6 +20,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Any
 
@@ -47,6 +48,11 @@ class Wal:
         self.term = 0
         self.commit_index = 0
         self.voted_for: int | None = None  # election mode only
+        # optional (event, info) sink set by the owner (the PS wires it
+        # to /metrics histograms). Same contract as the raft observer:
+        # cheap, non-blocking, exceptions swallowed — it fires under the
+        # WAL lock on the write path.
+        self.observer = None
         self._load_meta()
         self._recover()
         self._fd = open(self.path, "ab")
@@ -177,11 +183,25 @@ class Wal:
                 payload = json.dumps(e).encode()
                 buf += _HDR.pack(len(payload), zlib.crc32(payload))
                 buf += payload
+            t0 = time.time()
             self._fd.write(buf)
             self._fd.flush()
+            t_fsync = time.time()
             if fsync:
                 os.fsync(self._fd.fileno())
+            t1 = time.time()
             self._entries.extend(entries)
+            obs = self.observer
+            if obs is not None:
+                try:
+                    obs("append", {
+                        "entries": len(entries),
+                        "bytes": len(buf),
+                        "seconds": t1 - t0,
+                        "fsync_seconds": t1 - t_fsync if fsync else 0.0,
+                    })
+                except Exception:
+                    pass
 
     def truncate_suffix(self, from_index: int) -> None:
         """Drop entries >= from_index (conflict resolution on a follower

@@ -19,6 +19,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any
@@ -93,18 +94,31 @@ class RequestKilled(Exception):
 class RequestContext:
     """Kill flag for one in-flight request (reference:
     api_data/request_context.h; the PS slow-request killer and the
-    /ps/kill admin both flip it)."""
+    /ps/kill admin both flip it).
 
-    def __init__(self, request_id: str = ""):
+    `deadline` (absolute epoch seconds) arms check() itself: a request
+    past its deadline self-kills at the next phase boundary — between
+    device dispatches, never mid-kernel — without waiting on the PS
+    killer loop's tick. `reason_code` is the bounded label the PS
+    exports on vearch_requests_killed_total."""
+
+    def __init__(self, request_id: str = "",
+                 deadline: float | None = None):
         self.request_id = request_id
+        self.deadline = deadline
         self.killed = False
         self.reason = ""
+        self.reason_code = ""
 
-    def kill(self, reason: str = "killed") -> None:
+    def kill(self, reason: str = "killed", code: str = "operator") -> None:
         self.killed = True
         self.reason = reason
+        self.reason_code = code
 
     def check(self) -> None:
+        if (not self.killed and self.deadline is not None
+                and time.time() > self.deadline):
+            self.kill("deadline exceeded", code="deadline")
         if self.killed:
             raise RequestKilled(self.reason or "request killed")
 
@@ -135,6 +149,14 @@ class Engine:
         self.vector_stores: dict[str, RawVectorStore] = {}
         self.indexes: dict[str, VectorIndex] = {}
         self.status = IndexStatus.UNINDEXED
+        self.last_build_error: BaseException | None = None
+        # current/last index-build job record (build_index fills it) —
+        # the PS serves these at GET /ps/jobs and rides the terminal
+        # status on heartbeats for the master's /cluster/health rollup
+        self.build_job: dict | None = None
+        # optional terminal-state sink (PS wires build-duration
+        # histograms through it; covers background auto-builds too)
+        self.build_observer = None
         self._write_lock = threading.Lock()
         # field -> in-flight build marker; stops the heartbeat reconcile
         # loop re-spawning a build every 2s while a long background build
@@ -715,30 +737,84 @@ class Engine:
                 self._scalar_manager.remove_field(field)
             f.scalar_index = ScalarIndexType.NONE
 
-    def build_index(self, field_name: str | None = None) -> None:
+    def build_index(self, field_name: str | None = None,
+                    op: str = "build") -> None:
         """Train + absorb all current rows (reference: engine.cc:966
         BuildIndex -> Indexing thread; here synchronous — the cluster
-        layer wraps it in a background thread)."""
+        layer wraps it in a background thread).
+
+        The build is an observable job: `self.build_job` tracks phase
+        (train / assign / publish / warmup), progress (docs_done /
+        docs_total) and terminal status while the build runs, with the
+        real wall window of each phase kept as `_phase_spans` rows for
+        the PS to replay into /debug/traces."""
+        t_start = time.time()
+        targets = [
+            (name, idx) for name, idx in self.indexes.items()
+            if field_name is None or name == field_name
+        ]
+        job: dict[str, Any] = {
+            "op": op, "status": "running", "phase": "train",
+            "docs_total": sum(
+                self.vector_stores[n].count for n, _ in targets),
+            "docs_done": 0, "started": t_start, "updated": t_start,
+            "phases_ms": {}, "error": None, "_phase_spans": [],
+        }
+        self.build_job = job
+        phases = job["_phase_spans"]
+
+        def mark(phase: str, t0: float, t1: float) -> None:
+            phases.append((f"build.{phase}", int(t0 * 1e6),
+                           int((t1 - t0) * 1e6)))
+            job["phases_ms"][phase] = round(
+                job["phases_ms"].get(phase, 0.0) + (t1 - t0) * 1e3, 3)
+            job["phase"] = phase
+            job["updated"] = t1
+
         self.status = IndexStatus.TRAINING
         try:
-            for name, index in self.indexes.items():
-                if field_name is not None and name != field_name:
-                    continue
+            for name, index in targets:
                 store = self.vector_stores[name]
                 if index.needs_training and not index.trained:
+                    t0 = time.time()
                     index.train(store.host_view())
+                    mark("train", t0, time.time())
+                t0 = time.time()
                 index.absorb(store.count)
+                mark("assign", t0, time.time())
+                job["docs_done"] += store.count
         except Exception as e:
             # a failed (possibly background) build must not wedge the
             # engine in TRAINING: record, reset, keep serving brute-force
             self.last_build_error = e
             self.status = IndexStatus.UNINDEXED
+            job.update(status="error",
+                       error=f"{type(e).__name__}: {e}",
+                       duration_seconds=round(time.time() - t_start, 3),
+                       updated=time.time())
+            self._notify_build(job)
             raise
+        t0 = time.time()
         self.status = IndexStatus.INDEXED
+        mark("publish", t0, time.time())
         # pre-trace the serving programs for the configured batch buckets
         # now, at publish time, so the first real query never pays the
         # compile stall (no-op unless "warmup_batches" is configured)
+        t0 = time.time()
         self.warmup(field_name=field_name)
+        mark("warmup", t0, time.time())
+        job.update(status="done", phase="done",
+                   duration_seconds=round(time.time() - t_start, 3),
+                   updated=time.time())
+        self._notify_build(job)
+
+    def _notify_build(self, job: dict) -> None:
+        obs = self.build_observer
+        if obs is not None:
+            try:
+                obs(job)
+            except Exception:
+                pass  # observability must never fail a build
 
     def warmup(
         self,
@@ -794,7 +870,7 @@ class Engine:
             store = self.vector_stores[name]
             self.indexes[name] = create_index(params, store)
         self.status = IndexStatus.UNINDEXED
-        self.build_index()
+        self.build_index(op="rebuild")
 
     def _training_threshold(self, index: VectorIndex) -> int:
         """Docs required before auto-build starts; explicit build_index()

@@ -61,16 +61,24 @@ class VearchClient:
 
     # -- documents -----------------------------------------------------------
 
-    def upsert(self, db_name: str, space_name: str, documents: list[dict]) -> dict:
+    def upsert(self, db_name: str, space_name: str, documents: list[dict],
+               profile: bool = False) -> dict:
+        """Upsert documents. With ``profile=True`` the response carries a
+        router-merged write-side phase breakdown (propose-wait, WAL
+        append+fsync, commit-wait, engine apply) per partition — the
+        mutation-plane mirror of ``search(profile=True)``."""
         documents = [
             {k: (v.tolist() if isinstance(v, np.ndarray) else v)
              for k, v in d.items()}
             for d in documents
         ]
-        return rpc.call(self.addr, "POST", "/document/upsert", {
+        body = {
             "db_name": db_name, "space_name": space_name,
             "documents": documents,
-        })
+        }
+        if profile:
+            body["profile"] = True
+        return rpc.call(self.addr, "POST", "/document/upsert", body)
 
     def search(
         self,
@@ -88,6 +96,7 @@ class VearchClient:
         page_size: int | None = None,
         page_num: int | None = None,
         profile: bool = False,
+        deadline_ms: float | None = None,
     ) -> list[list[dict]] | dict:
         """Search `space_name`; returns per-query hit lists.
 
@@ -123,6 +132,11 @@ class VearchClient:
             body["page_size"] = page_size
         if page_num is not None:
             body["page_num"] = page_num
+        if deadline_ms is not None:
+            # per-request execution budget: each partition server arms a
+            # kill between device dispatches; an expired request fails
+            # with a terminal request_killed error (never retried)
+            body["deadline_ms"] = deadline_ms
         if profile:
             body["profile"] = True
             return rpc.call(self.addr, "POST", "/document/search", body)
