@@ -402,6 +402,53 @@ def main():
     emit("latency", ms_b1=round(lat[1] * 1e3, 1),
          ms_b32=round(lat[32] * 1e3, 1))
 
+    # -- cache effectiveness (multi-tier cache tentpole): replay a
+    # Zipfian request mix through the same VersionedLRUCache the
+    # router/PS tiers use — the measured effective QPS under a
+    # realistic hit rate is the serving win the cache claims, and the
+    # Amdahl model (perf_model.effective_qps) is checked against it.
+    # Emitted as a partial like every phase, so a dead tunnel still
+    # leaves the number behind (VEARCH_BENCH_CACHE dir).
+    from vearch_tpu.cluster.querycache import (
+        VersionedLRUCache,
+        canonical_query_key,
+    )
+
+    pool_n, n_reqs = (20, 200) if _dryrun() else (100, 1000)
+    zrng = np.random.default_rng(11)
+    # zipf(1.2) ranks capped to the pool: a heavy-tailed popularity
+    # curve (few hot queries, long cold tail) instead of uniform reuse
+    ranks = np.minimum(zrng.zipf(1.2, size=n_reqs) - 1, pool_n - 1)
+    qcache = VersionedLRUCache(max_entries=pool_n)
+    misses = 0
+    t0 = time.time()
+    for i in ranks:
+        ckey = canonical_query_key(
+            "bench/s", {"emb": queries[i:i + 1]}, 10, None)
+        if qcache.get(ckey) is None:
+            misses += 1
+            r1 = eng.search(SearchRequest(
+                vectors={"emb": queries[i:i + 1]}, k=10,
+                include_fields=[], raw_results=True,
+                index_params={"rerank": 128}))
+            qcache.put(ckey, r1)
+    t_mix = time.time() - t0
+    hit_rate = 1.0 - misses / n_reqs
+    cold_qps_b1 = 1.0 / lat[1] if lat[1] else 0.0
+    eff_qps = n_reqs / t_mix if t_mix else 0.0
+    cache_diag = {
+        "pool": pool_n,
+        "requests": n_reqs,
+        "hit_rate": round(hit_rate, 3),
+        "cold_qps_b1": round(cold_qps_b1, 1),
+        "effective_qps": round(eff_qps, 1),
+        "speedup_vs_cold": round(eff_qps / cold_qps_b1, 2)
+        if cold_qps_b1 else 0.0,
+        "model_effective_qps": round(
+            perf_model.effective_qps(cold_qps_b1, hit_rate), 1),
+    }
+    emit("cache_effectiveness", **cache_diag)
+
     # -- per-phase breakdown (r4 review next-1: the captured headline
     # must be decomposable — where does the wall time go?) ------------
     from vearch_tpu.ops import ivf as ivf_ops
@@ -540,6 +587,7 @@ def main():
         "recall_at_10": round(recall, 4),
         "phase_ms": phase_ms,
         "roofline": roofline_diag,
+        "cache": cache_diag,
         **glove_diag,
         **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),

@@ -36,6 +36,12 @@ _METRIC_RE = re.compile(
 # span factories: tracer.span("name"...) / tracer.record("name"... or
 # f"raft.{event}"...); engine phase rows: phases.append(("name", ...)
 # or spans.append(["name"/f"kernel.{tag}", ...
+# post-creation span tags (`span.set_tag("cache", ...)`) mark
+# per-request facts the operator greps for mid-incident; every literal
+# key must appear backticked in the doc. One-directional: single-word
+# doc backticks are too generic to demand a registration behind each.
+_TAG_RE = re.compile(r"\.set_tag\(\s*[\"']([a-z_]+)[\"']")
+
 _SPAN_RES = [
     re.compile(r"\.span\(\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
     re.compile(r"\.record\(\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
@@ -49,18 +55,20 @@ def _normalize(name: str) -> str:
     return re.sub(r"[{<][^}>]*[}>]", "*", name)
 
 
-def source_names() -> tuple[set[str], set[str]]:
+def source_names() -> tuple[set[str], set[str], set[str]]:
     metrics: set[str] = set()
     spans: set[str] = set()
+    tags: set[str] = set()
     for root, _dirs, files in os.walk(SRC):
         for fn in files:
             if not fn.endswith(".py"):
                 continue
             text = open(os.path.join(root, fn)).read()
             metrics.update(_METRIC_RE.findall(text))
+            tags.update(_TAG_RE.findall(text))
             for rx in _SPAN_RES:
                 spans.update(_normalize(n) for n in rx.findall(text))
-    return metrics, spans
+    return metrics, spans, tags
 
 
 def doc_names() -> tuple[set[str], set[str]]:
@@ -80,8 +88,9 @@ def doc_names() -> tuple[set[str], set[str]]:
 
 
 def main() -> int:
-    src_metrics, src_spans = source_names()
+    src_metrics, src_spans, src_tags = source_names()
     doc_metrics, doc_spans = doc_names()
+    doc_words = set(re.findall(r"`([a-z_]+)`", open(DOC).read()))
     # keep only doc tokens whose first segment matches an emitted span
     # family — drops dotted prose like `dispatches.tags` (a JSON field,
     # not a span) without a hand-maintained prefix list
@@ -97,6 +106,8 @@ def main() -> int:
         failures.append(f"span emitted but undocumented: {name}")
     for name in sorted(doc_spans - src_spans):
         failures.append(f"span documented but never emitted: {name}")
+    for name in sorted(src_tags - doc_words):
+        failures.append(f"span tag set but undocumented: {name}")
 
     if failures:
         print("docs/OBSERVABILITY.md drift detected:")
@@ -104,7 +115,7 @@ def main() -> int:
             print(f"  - {f}")
         return 1
     print(f"obs docs in sync: {len(src_metrics)} metrics, "
-          f"{len(src_spans)} span families")
+          f"{len(src_spans)} span families, {len(src_tags)} span tags")
     return 0
 
 

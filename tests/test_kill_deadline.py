@@ -76,10 +76,13 @@ def cluster(tmp_path_factory):
 
 
 def _search(c: StandaloneCluster, qs: np.ndarray, **extra) -> dict:
+    # cache: false — these tests prove kills land BETWEEN dispatches,
+    # so the request must actually reach the engine; a repeat query
+    # served from the result cache would never arm the killer
     return rpc.call(c.router_addr, "POST", "/document/search", {
         "db_name": "db", "space_name": "s",
         "vectors": [{"field": "v", "feature": q.tolist()} for q in qs],
-        "limit": 5, **extra,
+        "limit": 5, "cache": False, **extra,
     })
 
 

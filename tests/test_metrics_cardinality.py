@@ -93,6 +93,66 @@ def test_profiled_soak_does_not_grow_series(cluster, rng):
         assert len(_series(text)) < 600, addr
 
 
+def test_cached_soak_does_not_grow_series(cluster, rng):
+    """Cache-tier mirror of the search soak: 1k queries served almost
+    entirely from the router/PS result caches (plus coalesced groups)
+    must not mint a single new series. Cache observability is
+    callback-rendered from pre-initialized stats dicts, so every
+    {event} label exists from the first scrape — hits/misses/coalesced
+    only move values, never label sets."""
+    import threading
+
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((100, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(100)])
+
+    # a small pool of hot queries: first touch misses, the rest hit
+    pool = [vecs[i:i + BATCH] for i in range(0, 5 * BATCH, BATCH)]
+
+    def search(qs: np.ndarray) -> None:
+        out = rpc.call(cluster.router_addr, "POST", "/document/search", {
+            "db_name": "db", "space_name": "s",
+            "vectors": [{"field": "v", "feature": q.tolist()} for q in qs],
+            "limit": 5,
+        })
+        assert out["documents"]
+
+    addrs = [cluster.router_addr] + [ps.addr for ps in cluster.ps_nodes]
+
+    for qs in pool:  # warm: one miss per pool entry, caches populated
+        search(qs)
+    baseline = {a: _series(scrape(a)) for a in addrs}
+
+    done = len(pool)
+    while done < N_QUERIES - 20:
+        search(pool[done % len(pool)])
+        done += 1
+    # a burst of concurrent identical FRESH queries exercises the
+    # coalesced path inside the soak window
+    fresh = vecs[:BATCH] + 0.5
+    ts = [threading.Thread(target=search, args=(fresh,)) for _ in range(20)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30.0)
+
+    stats = cluster.router.result_cache.stats
+    assert stats["hit"] >= N_QUERIES // 2, stats  # the soak DID hit
+    for addr in addrs:
+        text = scrape(addr)
+        grown = _series(text) - baseline[addr]
+        assert not grown, f"{addr}: series grew during cached soak: {grown}"
+        assert len(_series(text)) < 600, addr
+
+
 def test_profiled_write_soak_does_not_grow_series(cluster, rng):
     """Write-path mirror of the search soak: 1k profiled upserts plus a
     full index build after the baseline scrape must not mint a single

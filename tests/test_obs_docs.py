@@ -48,12 +48,24 @@ def test_gate_catches_stale_doc_entry(tmp_path, monkeypatch):
 
 def test_source_extraction_sees_known_names():
     mod = _load()
-    metrics, spans = mod.source_names()
+    metrics, spans, tags = mod.source_names()
     for name in ("vearch_raft_peer_lag", "vearch_raft_commit_latency_seconds",
                  "tracing_dropped_spans_total", "vearch_request_total",
-                 "vearch_cluster_servers"):
+                 "vearch_cluster_servers", "vearch_router_cache_events_total",
+                 "vearch_ps_search_cache_events_total"):
         assert name in metrics, name
     for name in ("router.search", "ps.search", "ps.gate_wait",
                  "microbatch.queue", "engine.search.*", "kernel.*",
                  "raft.*"):
         assert name in spans, name
+    assert "cache" in tags
+
+
+def test_gate_catches_undocumented_span_tag(tmp_path, monkeypatch):
+    """An undocumented post-creation span tag fails the gate too."""
+    mod = _load()
+    text = open(mod.DOC).read()
+    stripped = tmp_path / "OBSERVABILITY.md"
+    stripped.write_text(text.replace("`cache`", "cache"))
+    monkeypatch.setattr(mod, "DOC", str(stripped))
+    assert mod.main() == 1

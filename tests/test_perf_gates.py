@@ -206,6 +206,86 @@ def test_deadline_and_slowlog_capture_add_zero_device_work(ivfpq_engine):
     )
 
 
+# -- gate 2b: cache-hit dispatch gates (docs/PERF.md "Tier 4") ---------------
+
+
+def test_cached_search_adds_zero_dispatches_and_zero_programs(tmp_path):
+    """The serving-cache contract, stated on the device ledger: once a
+    query is cached, REPEATING it performs zero engine dispatches and
+    compiles zero new programs — and the engine's filter-bitmap cache
+    stops re-evaluating an identical filter even on cache-bypassing
+    requests."""
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    d = 16
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1)
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1,
+            "fields": [
+                {"name": "group", "data_type": "integer"},
+                {"name": "v", "data_type": "vector", "dimension": d,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        rng = np.random.default_rng(9)
+        vecs = rng.standard_normal((200, d)).astype(np.float32)
+        cl.upsert("db", "s", [
+            {"_id": f"d{i}", "group": i % 4, "v": vecs[i]}
+            for i in range(200)
+        ])
+
+        def search(**extra):
+            return rpc.call(c.router_addr, "POST", "/document/search", {
+                "db_name": "db", "space_name": "s",
+                "vectors": [{"field": "v", "feature": q.tolist()}
+                            for q in vecs[:2]],
+                "limit": 5, **extra,
+            })
+
+        search()  # cold: compiles, dispatches, populates every tier
+        before = perf_model.total_compiled_programs()
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            for _ in range(5):
+                search()
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        assert ledger.tags == [], (
+            f"repeated identical searches reached the device: "
+            f"{ledger.tags}"
+        )
+        assert perf_model.total_compiled_programs() == before, (
+            "a cache hit compiled new programs"
+        )
+
+        # filter-bitmap tier: identical filters on cache-bypassing
+        # requests still dispatch the scan but never re-evaluate the
+        # filter against the current data version
+        eng = c.ps_nodes[0].engines[next(iter(c.ps_nodes[0].engines))]
+        filt = {"operator": "AND", "conditions": [
+            {"field": "group", "operator": ">=", "value": 2}]}
+        search(filters=filt, cache=False)  # miss: evaluates + caches
+        hits0 = eng.filter_cache_hits
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            search(filters=filt, cache=False)
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        assert eng.filter_cache_hits == hits0 + 1
+        assert ledger.counts() == {"flat_scan": 1}  # bypass DID dispatch
+    finally:
+        c.stop()
+
+
 # -- gate 3: bytes materialized ----------------------------------------------
 
 

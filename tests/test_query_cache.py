@@ -1,0 +1,464 @@
+"""Multi-tier query cache + single-flight coalescing (perf tentpole).
+
+Three correctness properties are gated here with the module-global
+dispatch ledger (ivf_ops.set_dispatch_ledger sees every engine thread
+in the in-process cluster):
+
+- a router cache hit performs ZERO device dispatches and the profile
+  says so (``cache: hit``);
+- invalidation is version-EXACT: an upsert to one partition makes the
+  repeat search recompute only that partition (the untouched partition
+  answers from its PS result cache), and the new doc is visible
+  immediately — read-your-writes through the write-acking router;
+- N concurrent identical queries coalesce into ONE scatter (one
+  documented dispatch set total, N-1 ``coalesced`` responses).
+
+Plus unit coverage for the querycache primitives themselves.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import vearch_tpu.cluster.rpc as rpc
+from vearch_tpu.cluster.querycache import (
+    SingleFlight,
+    VersionedLRUCache,
+    canonical_query_key,
+)
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.ops import ivf as ivf_ops
+from vearch_tpu.ops import perf_model
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+N_DOCS = 40
+
+
+# -- unit: canonical keys -----------------------------------------------------
+
+
+def test_canonical_key_exact_bytes():
+    rng = np.random.default_rng(5)
+    v = rng.standard_normal((2, D)).astype(np.float32)
+    base = canonical_query_key("db/s", {"v": v}, 10, {"filters": None})
+    # byte-identical query -> same key, regardless of input container
+    assert canonical_query_key("db/s", {"v": v.tolist()}, 10,
+                               {"filters": None}) == base
+    # any numeric jitter, k change, option change, or space change
+    # aliases to a DIFFERENT key (exactness is the whole point)
+    jit = v.copy()
+    jit[0, 0] += 1e-6
+    assert canonical_query_key("db/s", {"v": jit}, 10,
+                               {"filters": None}) != base
+    assert canonical_query_key("db/s", {"v": v}, 11,
+                               {"filters": None}) != base
+    assert canonical_query_key("db/s", {"v": v}, 10,
+                               {"filters": {"f": 1}}) != base
+    assert canonical_query_key("db/t", {"v": v}, 10,
+                               {"filters": None}) != base
+
+
+# -- unit: versioned LRU ------------------------------------------------------
+
+
+def test_versioned_lru_exact_invalidation():
+    c = VersionedLRUCache(max_entries=4)
+    c.put("k", "val", {0: 3, 1: 7})
+    assert c.get("k", {0: 3, 1: 7}) == "val"
+    assert c.stats["hit"] == 1
+    # one partition applied a write -> entry gone, counted invalidated
+    assert c.get("k", {0: 4, 1: 7}) is None
+    assert c.stats["invalidated"] == 1
+    assert len(c) == 0
+    # partition-set change (split/expand) also invalidates
+    c.put("k", "val", {0: 3, 1: 7})
+    assert c.get("k", {0: 3, 1: 7, 2: 0}) is None
+    assert c.stats["invalidated"] == 2
+
+
+def test_versioned_lru_ttl_and_eviction():
+    c = VersionedLRUCache(max_entries=2, ttl_s=5.0)
+    c.put("a", 1, {}, now=100.0)
+    assert c.get("a", {}, now=104.0) == 1
+    assert c.get("a", {}, now=106.0) is None  # TTL safety net fired
+    assert c.stats["invalidated"] == 1
+    c.put("a", 1, {}, now=200.0)
+    c.put("b", 2, {}, now=200.0)
+    c.put("c", 3, {}, now=200.0)  # LRU-evicts "a"
+    assert c.stats["eviction"] == 1
+    assert c.get("a", {}, now=200.0) is None
+    assert c.get("c", {}, now=200.0) == 3
+    # disabled cache never stores
+    off = VersionedLRUCache(max_entries=0)
+    off.put("x", 1, {})
+    assert len(off) == 0
+
+
+# -- unit: single flight ------------------------------------------------------
+
+
+def test_single_flight_coalesces_and_forgets():
+    sf = SingleFlight()
+    calls = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow():
+        calls.append(1)
+        entered.set()
+        release.wait(5.0)
+        return "result"
+
+    out: list[tuple] = []
+    ts = [threading.Thread(target=lambda: out.append(sf.do("k", slow)))
+          for _ in range(4)]
+    ts[0].start()
+    assert entered.wait(5.0)
+    for t in ts[1:]:
+        t.start()
+    deadline = time.time() + 5.0
+    while sf.waiters("k") < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert sf.waiters("k") == 3
+    release.set()
+    for t in ts:
+        t.join(5.0)
+    assert len(calls) == 1  # one execution...
+    assert [v for v, _ in out] == ["result"] * 4  # ...four results
+    assert sorted(c for _, c in out) == [False, True, True, True]
+    # nothing memoized: the next call runs fn again
+    v, coalesced = sf.do("k", lambda: "again")
+    assert (v, coalesced) == ("again", False)
+
+
+def test_single_flight_propagates_errors():
+    sf = SingleFlight()
+    entered = threading.Event()
+    release = threading.Event()
+    errs: list[Exception] = []
+
+    def boom():
+        entered.set()
+        release.wait(5.0)
+        raise ValueError("leader failed")
+
+    def leader():
+        with pytest.raises(ValueError):
+            sf.do("k", boom)
+
+    def follower():
+        try:
+            sf.do("k", lambda: "unused")
+        except ValueError as e:
+            errs.append(e)
+
+    tl = threading.Thread(target=leader)
+    tl.start()
+    assert entered.wait(5.0)
+    tf = threading.Thread(target=follower)
+    tf.start()
+    deadline = time.time() + 5.0
+    while sf.waiters("k") < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    release.set()
+    tl.join(5.0)
+    tf.join(5.0)
+    assert len(errs) == 1 and "leader failed" in str(errs[0])
+
+
+# -- cluster fixture ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = StandaloneCluster(
+        data_dir=str(tmp_path_factory.mktemp("qcache") / "c"), n_ps=2)
+    c.start()
+    cl = VearchClient(c.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    rng = np.random.default_rng(21)
+    vecs = rng.standard_normal((N_DOCS, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(N_DOCS)])
+    # warm the serving path (compile) before any ledger assertions
+    _search(c, vecs[:1], cache=False)
+    yield c, cl, vecs
+    c.stop()
+
+
+def _search(c: StandaloneCluster, qs: np.ndarray, **extra) -> dict:
+    return rpc.call(c.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s",
+        "vectors": [{"field": "v", "feature": q.tolist()} for q in qs],
+        "limit": 5, "profile": True, **extra,
+    })
+
+
+def _ledgered(fn):
+    """Run fn under a fresh module-global dispatch ledger; the ledger
+    sees every engine dispatch across the in-process cluster's
+    threads."""
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        out = fn()
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    return out, ledger
+
+
+# -- gate: hit = zero dispatches ----------------------------------------------
+
+
+def test_router_hit_zero_dispatches(cluster):
+    c, cl, vecs = cluster
+    q = vecs[3:5]
+    cold = _search(c, q)  # populates router + PS caches
+    assert cold["profile"]["cache"] in ("miss", "hit")
+    warm, ledger = _ledgered(lambda: _search(c, q))
+    assert warm["profile"]["cache"] == "hit"
+    assert warm["profile"]["partitions"] == {}
+    assert warm["profile"]["partition_count"] == 2
+    assert warm["documents"] == cold["documents"]
+    assert ledger.tags == [], (
+        f"cache hit reached the device: {ledger.tags}"
+    )
+    assert perf_model.path_for_dispatches(ledger.tags) == "cache_hit"
+
+
+def test_trace_true_bypasses_router_cache(cluster):
+    """trace:true promises real per-partition timing -> never served
+    from the merged-result cache, even when an entry exists."""
+    c, cl, vecs = cluster
+    q = vecs[5:6]
+    _search(c, q)  # seed the entry
+    out = _search(c, q, trace=True)
+    assert out["profile"]["cache"] in ("uncacheable", "bypass")
+    assert out["params"], "trace:true must return per-partition timing"
+
+
+# -- gate: version-exact invalidation + read-your-writes ----------------------
+
+
+def test_write_invalidates_exactly_written_partition(cluster):
+    c, cl, vecs = cluster
+    # a query point no seeded doc occupies, so the doc written AT it
+    # below is the unique distance-0 answer (vecs[7] itself would tie
+    # with d7)
+    q = vecs[7:8] + 3.0
+    cold = _search(c, q)
+    hit = _search(c, q)
+    assert hit["profile"]["cache"] == "hit"
+
+    # write a doc whose vector IS the query: read-your-writes demands
+    # the very next search returns it at distance ~0
+    inv0 = c.router.result_cache.stats["invalidated"]
+    cl.upsert("db", "s", [{"_id": "rw-doc", "v": q[0]}])
+
+    after, ledger = _ledgered(lambda: _search(c, q))
+    assert after["profile"]["cache"] == "miss"
+    assert c.router.result_cache.stats["invalidated"] == inv0 + 1
+    ids = [r["_id"] for r in after["documents"][0]]
+    assert ids[0] == "rw-doc", (
+        f"stale read: wrote rw-doc at the query point, got {ids}"
+    )
+    # exactness: only the WRITTEN partition recomputed (one flat_scan);
+    # the untouched partition served its PS result cache (its apply
+    # version never moved, so its version-embedding key still matches)
+    assert ledger.counts() == {"flat_scan": 1}, (
+        f"expected exactly one partition to recompute, got "
+        f"{ledger.counts()}"
+    )
+
+    # and the refreshed entry serves hits again, with the new doc
+    again, ledger2 = _ledgered(lambda: _search(c, q))
+    assert again["profile"]["cache"] == "hit"
+    assert ledger2.tags == []
+    assert [r["_id"] for r in again["documents"][0]][0] == "rw-doc"
+
+
+def test_read_your_writes_under_concurrent_writers(cluster):
+    c, cl, vecs = cluster
+    rng = np.random.default_rng(77)
+    hot = vecs[9:10]
+    stop = threading.Event()
+    failures: list[str] = []
+
+    # vectors pre-drawn on the main thread (Generator is not
+    # thread-safe); each writer's cluster sits 10*(wid+1) away so its
+    # own doc is always the distance-0 top hit
+    draws = {
+        (wid, i): (rng.standard_normal(D).astype(np.float32)
+                   + 10.0 * (wid + 1))
+        for wid in range(3) for i in range(5)
+    }
+
+    def writer(wid: int):
+        for i in range(5):
+            w = draws[(wid, i)]
+            did = f"w{wid}-{i}"
+            try:
+                cl.upsert("db", "s", [{"_id": did, "v": w}])
+                out = _search(c, w[None, :])
+                ids = [r["_id"] for r in out["documents"][0]]
+                if ids[0] != did:
+                    failures.append(f"{did}: got {ids}")
+            except Exception as e:  # surfaced after join
+                failures.append(f"{did}: {e!r}")
+
+    def reader():
+        while not stop.is_set():
+            out = _search(c, hot)
+            if not out["documents"][0]:
+                failures.append("reader: empty result")
+
+    writers = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join(30.0)
+    stop.set()
+    rt.join(10.0)
+    assert not failures, failures
+    # the hot query's cached answer equals a forced recompute (scores
+    # to float32-ulp tolerance: the CPU backend's threaded reductions
+    # are not bit-stable run to run)
+    cached = _search(c, hot)
+    fresh = _search(c, hot, cache=False)
+    assert ([r["_id"] for r in cached["documents"][0]]
+            == [r["_id"] for r in fresh["documents"][0]])
+    np.testing.assert_allclose(
+        [r["_score"] for r in cached["documents"][0]],
+        [r["_score"] for r in fresh["documents"][0]], rtol=1e-5)
+
+
+# -- gate: coalescing = one dispatch set for N callers ------------------------
+
+
+def test_concurrent_identical_queries_coalesce_to_one_scatter(cluster):
+    c, cl, vecs = cluster
+    router = c.router
+    q = vecs[11:13] + 0.125  # fresh query: no tier has it cached
+    n_callers = 4
+
+    entered = threading.Event()
+    release = threading.Event()
+    orig = router._search_scatter
+
+    def stalled(*args, **kwargs):
+        entered.set()
+        release.wait(10.0)
+        return orig(*args, **kwargs)
+
+    outs: list[dict] = []
+
+    def call():
+        outs.append(_search(c, q))
+
+    coalesced0 = router.result_cache.stats["coalesced"]
+    router._search_scatter = stalled
+    try:
+        def run():
+            ts = [threading.Thread(target=call) for _ in range(n_callers)]
+            ts[0].start()
+            assert entered.wait(10.0), "leader never reached the scatter"
+            for t in ts[1:]:
+                t.start()
+            # release the stalled leader only once every follower is
+            # blocked inside the single-flight group
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                with router._search_flight._lock:
+                    waiting = sum(f.waiters for f in
+                                  router._search_flight._flights.values())
+                if waiting >= n_callers - 1:
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("followers never coalesced onto the flight")
+            release.set()
+            for t in ts:
+                t.join(15.0)
+
+        _, ledger = _ledgered(run)
+    finally:
+        router._search_scatter = orig
+        release.set()
+
+    assert len(outs) == n_callers
+    # one scatter over two partitions, total — not per caller
+    assert ledger.counts() == {"flat_scan": 2}, (
+        f"{n_callers} identical queries dispatched {ledger.counts()}"
+    )
+    statuses = sorted(o["profile"]["cache"] for o in outs)
+    assert statuses == ["coalesced"] * (n_callers - 1) + ["miss"]
+    assert (router.result_cache.stats["coalesced"]
+            == coalesced0 + n_callers - 1)
+    docs = outs[0]["documents"]
+    assert all(o["documents"] == docs for o in outs)
+
+
+# -- gate: per-request bypass -------------------------------------------------
+
+
+def test_cache_false_always_recomputes(cluster):
+    c, cl, vecs = cluster
+    q = vecs[15:16]
+    _search(c, q)  # seed every tier
+
+    def twice():
+        a = _search(c, q, cache=False)
+        b = _search(c, q, cache=False)
+        return a, b
+
+    (a, b), ledger = _ledgered(twice)
+    assert a["profile"]["cache"] == "bypass"
+    assert b["profile"]["cache"] == "bypass"
+    # both requests hit both engines: 2 searches x 2 partitions
+    assert ledger.counts() == {"flat_scan": 4}, ledger.counts()
+    # the bypass is counted at the router for observability
+    assert c.router.result_cache.stats["bypass"] >= 2
+
+
+def test_sdk_cache_kwarg_reaches_router(cluster):
+    c, cl, vecs = cluster
+    q = [{"field": "v", "feature": vecs[17]}]
+    cl.search("db", "s", q, limit=5)  # seed
+    out = cl.search("db", "s", q, limit=5, profile=True, cache=False)
+    assert out["profile"]["cache"] == "bypass"
+    hit = cl.search("db", "s", q, limit=5, profile=True)
+    assert hit["profile"]["cache"] == "hit"
+
+
+# -- PS tier observability ----------------------------------------------------
+
+
+def test_ps_stats_expose_cache_counters(cluster):
+    c, cl, vecs = cluster
+    q = vecs[19:21]
+    _search(c, q)
+    _search(c, q, cache=False)  # forces PS-tier bypass accounting too
+    totals = {e: 0 for e in VersionedLRUCache.EVENTS}
+    for ps in c.ps_nodes:
+        stats = rpc.call(ps.addr, "GET", "/ps/stats")
+        sc = stats["search_cache"]
+        # every event key renders on every PS (pre-initialized stats:
+        # the cardinality soak depends on full label sets from scrape 1)
+        assert set(VersionedLRUCache.EVENTS) <= set(sc)
+        for e in totals:
+            totals[e] += sc[e]
+    # partition placement may concentrate on one PS; the fleet-wide
+    # totals must still show the bypass and the earlier misses
+    assert totals["bypass"] >= 1
+    assert totals["miss"] >= 1

@@ -121,7 +121,9 @@ def test_path_for_dispatches_reverse_lookup():
     for path, tags in doc.items():
         assert perf_model.path_for_dispatches(list(tags)) == path
     assert perf_model.path_for_dispatches(["nope"]) is None
-    assert perf_model.path_for_dispatches([]) is None
+    # the empty sequence is now a *documented* path: a cache hit
+    # launches zero device programs by design
+    assert perf_model.path_for_dispatches([]) == "cache_hit"
 
 
 def test_profile_disabled_trace_has_no_capture(cluster, rng):

@@ -128,6 +128,8 @@ def main(argv: list[str] | None = None) -> int:
             backup_roots=cfg_ps.get("backup_roots"),
             backup_endpoints=cfg_ps.get("backup_endpoints"),
             trace_collector=cfg_tr.get("collector_endpoint"),
+            search_cache_entries=int(
+                cfg_ps.get("search_cache_entries", 256)),
         )
         server.start()
         print(f"ps node {server.node_id}: http://{server.addr}", flush=True)
@@ -137,11 +139,14 @@ def main(argv: list[str] | None = None) -> int:
 
     from vearch_tpu.cluster.router import RouterServer
 
+    cfg_rt = {}
     cfg_tr = {}
     if args.conf:
         from vearch_tpu.cluster.config import Config
 
-        cfg_tr = getattr(Config.load(args.conf), "tracer", {}) or {}
+        cfg = Config.load(args.conf)
+        cfg_rt = getattr(cfg, "router", {}) or {}
+        cfg_tr = getattr(cfg, "tracer", {}) or {}
     server = RouterServer(
         master_addr=args.master_addr, host=args.host, port=args.port,
         auth=args.auth,
@@ -151,6 +156,11 @@ def main(argv: list[str] | None = None) -> int:
         trace_export=cfg_tr.get("export_path"),
         trace_collector=cfg_tr.get("collector_endpoint"),
         grpc_port=args.grpc_port,
+        # fan-out pool size (0 = auto with partition count) and the
+        # merged-result cache knobs from the [router] block
+        fanout_workers=int(cfg_rt.get("fanout_workers", 0)),
+        cache_entries=int(cfg_rt.get("cache_entries", 512)),
+        cache_ttl_s=float(cfg_rt.get("cache_ttl_s", 10.0)),
     )
     server.start()
     print(f"router: http://{server.addr}", flush=True)

@@ -17,10 +17,14 @@ Example:
 
     [router]
     port = 9001
+    fanout_workers = 0        # 0 = auto-size with partition count
+    cache_entries = 512       # merged-result cache; 0 disables
+    cache_ttl_s = 10.0        # safety net for unseen writers
 
     [ps]
     port = 8081
     max_concurrent_searches = 256
+    search_cache_entries = 256  # partition result cache; 0 disables
 """
 
 from __future__ import annotations
@@ -67,6 +71,16 @@ class Config:
         rate = self.tracer.get("sample_rate")
         if rate is not None and not (0.0 <= float(rate) <= 1.0):
             raise ValueError("[tracer] sample_rate must be in [0, 1]")
+        for key in ("fanout_workers", "cache_entries"):
+            v = self.router.get(key)
+            if v is not None and int(v) < 0:
+                raise ValueError(f"[router] {key} must be >= 0")
+        ttl = self.router.get("cache_ttl_s")
+        if ttl is not None and float(ttl) < 0:
+            raise ValueError("[router] cache_ttl_s must be >= 0")
+        sce = self.ps.get("search_cache_entries")
+        if sce is not None and int(sce) < 0:
+            raise ValueError("[ps] search_cache_entries must be >= 0")
 
     @property
     def data_dir(self) -> str:

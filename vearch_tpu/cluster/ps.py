@@ -112,6 +112,7 @@ class PSServer:
         raft_tick: float = 0.4,
         labels: dict[str, str] | None = None,
         trace_collector: str | None = None,
+        search_cache_entries: int = 256,
     ):
         from vearch_tpu.utils import apply_jax_platform_env
 
@@ -195,6 +196,22 @@ class PSServer:
         )
         self._search_ewma: dict[int, float] = {}  # pid -> ms
         self.slow_routed = 0
+        # PS-tier result cache + coalescing (perf tentpole: the
+        # cheapest dispatch is the one never issued). Keys embed
+        # (partition, canonical query, raft apply index, engine data
+        # version), so any applied write makes every prior entry for
+        # that partition unreachable — exact invalidation without a
+        # flush pass; superseded keys simply age out of the LRU.
+        # SingleFlight collapses N concurrent identical searches into
+        # one engine dispatch set. Runtime-tunable via /ps/engine/
+        # config {"search_cache_entries": n}; 0 disables.
+        from vearch_tpu.cluster.querycache import (
+            SingleFlight, VersionedLRUCache,
+        )
+
+        self.search_cache = VersionedLRUCache(
+            max_entries=search_cache_entries)
+        self._search_flight = SingleFlight()
 
         from vearch_tpu.cluster.tracing import NULL_SPAN, SlowLog, Tracer
 
@@ -406,6 +423,36 @@ class PSServer:
         m.callback_counter("vearch_raft_snapshots_total",
                            "raft snapshots by direction",
                            ("partition", "direction"), _snapshots)
+
+        # serving-cache observability (caching tentpole). Callback
+        # metrics read the cache's pre-initialized stats dict, so the
+        # full event label set exists from the first scrape — a cache
+        # warming up mid-soak must not mint new series.
+        def _search_cache_events():
+            return {(e,): float(v)
+                    for e, v in self.search_cache.stats.items()}
+
+        m.callback_counter("vearch_ps_search_cache_events_total",
+                           "partition result-cache events "
+                           "(hit/miss/coalesced/bypass/eviction/"
+                           "invalidated)",
+                           ("event",), _search_cache_events)
+        m.callback_gauge("vearch_ps_search_cache_entries",
+                         "live entries in the partition result cache",
+                         (),
+                         lambda: {(): float(len(self.search_cache))})
+
+        def _filter_cache_events():
+            hits = misses = 0
+            for eng in list(self.engines.values()):
+                hits += getattr(eng, "filter_cache_hits", 0)
+                misses += getattr(eng, "filter_cache_misses", 0)
+            return {("hit",): float(hits), ("miss",): float(misses)}
+
+        m.callback_counter("vearch_ps_filter_cache_events_total",
+                           "scalar-filter bitmap cache events summed "
+                           "across hosted engines",
+                           ("event",), _filter_cache_events)
         register_tracer_metrics(m, self.tracer)
 
     # -- lifecycle -----------------------------------------------------------
@@ -487,6 +534,16 @@ class PSServer:
                     # master's /cluster/health can roll up in-flight and
                     # failed builds cluster-wide
                     "build_status": job.get("status") if job else None,
+                    # data-version signal for the router result cache:
+                    # the raft apply index (or the engine's own version
+                    # counter off-raft) piggybacks on heartbeats so
+                    # cache entries can be revalidated out-of-band of
+                    # the search path
+                    "apply_version": (
+                        int(self.raft_nodes[pid].applied)
+                        if pid in self.raft_nodes
+                        else int(eng.data_version)
+                    ),
                 }
             except Exception:
                 continue
@@ -999,8 +1056,9 @@ class PSServer:
                                    "docs": len(docs)})
             if tctx else NULL_SPAN
         )
+        node = self._node(pid)
         with span:
-            keys = self._node(pid).propose(
+            keys = node.propose(
                 [{"type": "upsert", "documents": docs}], timing=timing)[0]
             if timing is not None:
                 timing["doc_count"] = len(docs)
@@ -1008,7 +1066,12 @@ class PSServer:
         if isinstance(keys, dict) and "_rejected" in keys:
             raise RpcError(400, keys["_rejected"])
         self._write_docs_total.inc(str(pid), "upsert", by=float(len(docs)))
-        out = {"keys": keys, "count": len(keys)}
+        # propose() returns only after the entry applied locally, so
+        # this applied index covers the write just acknowledged — the
+        # router bumps its version map from it, which is exactly what
+        # keeps read-your-writes through the result cache
+        out = {"keys": keys, "count": len(keys),
+               "apply_version": int(node.applied)}
         if profile:
             out["profile"] = _write_profile_from_timing(timing or {})
         return out
@@ -1052,7 +1115,8 @@ class PSServer:
                     self._replay_write_spans(span, timing, pid)
             self._write_docs_total.inc(str(pid), "delete",
                                        by=float(deleted or 0))
-            out = {"deleted": deleted}
+            out = {"deleted": deleted,
+                   "apply_version": int(node.applied)}
             if profile:
                 out["profile"] = _write_profile_from_timing(timing or {})
             return out
@@ -1077,7 +1141,7 @@ class PSServer:
             if len(docs) < want:
                 break
         self._write_docs_total.inc(str(pid), "delete", by=float(deleted))
-        return {"deleted": deleted}
+        return {"deleted": deleted, "apply_version": int(node.applied)}
 
     def _h_get(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])
@@ -1230,8 +1294,20 @@ class PSServer:
         )
         try:
             with span:
-                out = self._do_search(eng, body, vectors, ctx, trace)
-                timing = out.get("timing")
+                # apply version captured BEFORE the search runs: a
+                # write landing mid-search makes the resulting cache
+                # entry *older*-labeled, so it can never serve a state
+                # the writer was already acknowledged for
+                rnode = self.raft_nodes.get(pid)
+                applied = (int(rnode.applied) if rnode is not None
+                           else int(eng.data_version))
+                out, cache_status, timing = self._cached_search(
+                    eng, pid, applied, body, vectors, ctx, trace
+                )
+                # every response carries the partition's apply version
+                # — the router's entry-validation signal
+                out["apply_version"] = applied
+                span.set_tag("cache", cache_status)
                 if timing is not None:
                     timing["gate_wait_ms"] = gate_wait_ms
                     # engine phase windows -> real child spans under
@@ -1254,11 +1330,19 @@ class PSServer:
                     for phase, ms in timing.items():
                         span.set_tag(phase, ms)
                 if body.get("profile"):
-                    out["profile"] = _profile_from_timing(timing or {})
-                if not want_trace:
-                    # forced-on timing is internal observability, not
-                    # part of the client's response contract
-                    out.pop("timing", None)
+                    prof = _profile_from_timing(timing or {})
+                    prof["cache"] = cache_status
+                    if timing is None and cache_status in (
+                            "hit", "coalesced"):
+                        # no engine work happened for THIS response;
+                        # the zero-dispatch claim is explicit, not an
+                        # absence the reader must infer
+                        prof["dispatches"]["path"] = "cache_hit"
+                    out["profile"] = prof
+                if want_trace and timing is not None:
+                    # _cached_search detaches timing from the shared
+                    # payload; re-attach only when the client asked
+                    out["timing"] = timing
                 return out
         except RequestKilled as e:
             reason = ctx.reason_code or "operator"
@@ -1298,6 +1382,68 @@ class PSServer:
                     "dispatches": t.get("dispatches"),
                     "trace_id": span.trace_id or None,
                 })
+
+    def _cached_search(self, eng, pid, applied, body, vectors, ctx,
+                       trace):
+        """Result-cache + single-flight wrapper around _do_search.
+
+        Returns ``(out, cache_status, timing)``: `out` is a fresh
+        top-level dict per caller (hit/coalesced responses share the
+        row payload but never the envelope, so later mutation of one
+        response cannot leak into another), `cache_status` is one of
+        hit/miss/coalesced/bypass, and `timing` is the engine trace of
+        the request that actually computed (None for hit/coalesced —
+        they did no engine work to explain). A coalesced follower also
+        counts a `miss` (it did miss the cache) plus `coalesced`.
+        """
+        from vearch_tpu.cluster.querycache import canonical_query_key
+
+        cacheable = (
+            self.search_cache.max_entries > 0
+            and body.get("cache", True) is not False
+            and not body.get("raft_consistent")
+            # trace:true promises a real phase/dispatch breakdown and
+            # a replayed span tree — a hit has neither to offer
+            and not body.get("trace")
+        )
+        if not cacheable:
+            if body.get("cache", True) is False:
+                self.search_cache.note("bypass")
+            out = self._do_search(eng, body, vectors, ctx, trace)
+            return out, "bypass", out.pop("timing", None)
+        ckey = canonical_query_key(
+            str(pid), vectors, int(body.get("k", 10)),
+            {
+                "filters": body.get("filters"),
+                "include_fields": body.get("include_fields"),
+                "columnar_wire": bool(body.get("columnar_wire")),
+                "sort": body.get("sort"),
+                "index_params": body.get("index_params"),
+                "brute_force": bool(body.get("brute_force", False)),
+                "score_bounds": body.get("score_bounds"),
+                "field_weights": body.get("field_weights"),
+            },
+        )
+        # raft apply index AND engine data version are part of the
+        # key: any applied write bumps one of them, so every prior
+        # entry for this partition becomes unreachable (exact
+        # invalidation) and ages out of the LRU under pressure
+        key = (pid, ckey, applied, eng.data_version)
+        ent = self.search_cache.get(key)
+        if ent is not None:
+            return dict(ent), "hit", None
+
+        def compute():
+            out = self._do_search(eng, body, vectors, ctx, trace)
+            timing = out.pop("timing", None)
+            self.search_cache.put(key, out)
+            return out, timing
+
+        (out, timing), coalesced = self._search_flight.do(key, compute)
+        if coalesced:
+            self.search_cache.note("coalesced")
+            return dict(out), "coalesced", None
+        return dict(out), "miss", timing
 
     def _do_search(self, eng, body, vectors, ctx=None,
                    trace: dict | None = None) -> dict:
@@ -1521,6 +1667,14 @@ class PSServer:
             # default per-request deadline; a search's own deadline_ms
             # option overrides it per request
             self.request_deadline_ms = int(cfg["request_deadline_ms"])
+        if "search_cache_entries" in cfg:
+            # runtime-resizable result cache; 0 disables AND drops the
+            # live entries (an operator turning the cache off expects
+            # no further hits, not a slow drain)
+            n = int(cfg["search_cache_entries"])
+            self.search_cache.max_entries = n
+            if n <= 0:
+                self.search_cache.clear()
         if "log_level" in cfg:
             # runtime log-level flip, fanned out by the master's /config
             # (reference: log-level runtime config in pkg/log)
@@ -1696,6 +1850,10 @@ class PSServer:
             "replication_errors": self.replication_errors,
             "killed_requests": self.killed_requests,
             "slow_routed": self.slow_routed,
+            "search_cache": {
+                "entries": len(self.search_cache),
+                **self.search_cache.stats,
+            },
             # snapshot first: search threads insert keys lock-free
             "search_ewma_ms": {
                 str(pid): round(ms, 2)

@@ -36,6 +36,9 @@ class RouterServer:
         trace_export: str | None = None,
         trace_collector: str | None = None,
         grpc_port: int | None = None,
+        fanout_workers: int = 0,
+        cache_entries: int = 512,
+        cache_ttl_s: float = 10.0,
     ):
         from vearch_tpu.cluster.tracing import SlowLog, Tracer
 
@@ -57,7 +60,32 @@ class RouterServer:
         self._server_cache: tuple[float, dict[int, Server]] = (0.0, {})
         self._auth_cache: dict[tuple[str, str], tuple[float, dict]] = {}
         self._cache_lock = threading.Lock()
-        self._pool = ThreadPoolExecutor(max_workers=32)
+        # fan-out pool: config-driven (`fanout_workers`); 0 = auto,
+        # growing with the partition count seen at serve time (4 RPCs
+        # in flight per partition, floor 32, cap 256) so a wide space
+        # is not serialized behind a fixed 32-worker pool
+        self.fanout_workers = int(fanout_workers)
+        self._pool_lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.fanout_workers or 32)
+        # merged-result cache + single-flight (caching tentpole).
+        # Entries record the per-partition apply versions they were
+        # computed against; `_part_versions` tracks the newest version
+        # each partition has acknowledged to THIS router (responses to
+        # searches AND writes carry it), so a write through this
+        # router invalidates exactly the entries computed before it —
+        # read-your-writes holds with no TTL guesswork. The TTL is
+        # only the safety net for writes this router never saw
+        # (another router, direct-PS callers).
+        from vearch_tpu.cluster.querycache import (
+            SingleFlight, VersionedLRUCache,
+        )
+
+        self.result_cache = VersionedLRUCache(
+            max_entries=cache_entries, ttl_s=cache_ttl_s)
+        self._search_flight = SingleFlight()
+        self._part_versions: dict[int, int] = {}
+        self._part_versions_lock = threading.Lock()
         # TTL is the fallback freshness bound; the watch loop below
         # usually invalidates within one long-poll round trip
         self.space_cache_ttl = SPACE_CACHE_TTL
@@ -102,6 +130,33 @@ class RouterServer:
         from vearch_tpu.cluster.metrics import register_tracer_metrics
 
         register_tracer_metrics(s.metrics, self.tracer)
+
+        # fan-out saturation + result-cache observability. Callback
+        # metrics read pre-initialized sources, so the full label set
+        # renders from the first scrape (cardinality-soak contract).
+        m = s.metrics
+        m.callback_gauge(
+            "vearch_router_fanout_pool_size",
+            "current worker capacity of the scatter thread pool", (),
+            lambda: {(): float(self._pool._max_workers)})
+        m.callback_gauge(
+            "vearch_router_fanout_queue_depth",
+            "scatter RPCs queued waiting for a pool worker "
+            "(sustained >0 means the pool is saturated)", (),
+            lambda: {(): float(self._pool._work_queue.qsize())})
+
+        def _cache_events():
+            return {(e,): float(v)
+                    for e, v in self.result_cache.stats.items()}
+
+        m.callback_counter(
+            "vearch_router_cache_events_total",
+            "merged-result cache events (hit/miss/coalesced/bypass/"
+            "eviction/invalidated)", ("event",), _cache_events)
+        m.callback_gauge(
+            "vearch_router_cache_entries",
+            "live entries in the merged-result cache", (),
+            lambda: {(): float(len(self.result_cache))})
 
     def start(self) -> None:
         self.server.start()
@@ -210,7 +265,42 @@ class RouterServer:
                 },
                 "space_cache": len(self._space_cache),
                 "server_cache": len(self._server_cache[1]),
+                "fanout_pool_size": self._pool._max_workers,
+                "fanout_queue_depth": self._pool._work_queue.qsize(),
+                "result_cache": {
+                    "entries": len(self.result_cache),
+                    **self.result_cache.stats,
+                },
             }
+
+    def _ensure_pool_capacity(self, n_partitions: int) -> None:
+        """Auto-size the fan-out pool to the widest space served so
+        far: 4 in-flight RPCs per partition (retries + concurrent
+        requests), floor 32, cap 256. Growth-only — CPython's
+        ThreadPoolExecutor reads _max_workers at submit time, so
+        raising it takes effect without rebuilding the pool (and
+        without abandoning queued work). A nonzero `fanout_workers`
+        config pins the size and disables auto-growth."""
+        if self.fanout_workers:
+            return
+        want = min(max(32, 4 * n_partitions), 256)
+        if want <= self._pool._max_workers:
+            return
+        with self._pool_lock:
+            if want > self._pool._max_workers:
+                self._pool._max_workers = want
+
+    def _note_apply_version(self, pid: int, version) -> None:
+        """Record the newest apply version a partition acknowledged to
+        this router. Monotonic max: scatter responses complete out of
+        order, and a late search response carrying an older version
+        must not roll the map back past a write already acked."""
+        if version is None:
+            return
+        v = int(version)
+        with self._part_versions_lock:
+            if v > self._part_versions.get(pid, -1):
+                self._part_versions[pid] = v
 
     @property
     def addr(self) -> str:
@@ -539,6 +629,7 @@ class RouterServer:
     def _h_upsert(self, body: dict, _parts) -> dict:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
+        self._ensure_pool_capacity(len(space.partitions))
         self._validate_docs(space, body["documents"])
         by_partition = self._route_docs(space, body["documents"])
 
@@ -579,6 +670,11 @@ class RouterServer:
                 with span:
                     r = self._call_partition(skey, pid, "/ps/doc/upsert",
                                              body_p)
+                # the write ack carries the apply version that covers
+                # it — bumping the validity map HERE is what makes a
+                # read-your-writes search through this router miss the
+                # cache instead of serving pre-write results
+                self._note_apply_version(pid, r.get("apply_version"))
                 r["_rpc_ms"] = round((time.time() - t0) * 1e3, 3)
                 return pid, r
 
@@ -755,6 +851,7 @@ class RouterServer:
     def _search_impl(self, body: dict) -> dict:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
+        self._ensure_pool_capacity(len(space.partitions))
         vectors, score_bounds = self._parse_vectors(space, body)
         k = int(body.get("limit", body.get("topn", 10)))
         sort_specs = self._parse_sort_body(space, body)
@@ -794,6 +891,9 @@ class RouterServer:
                 r["field"]: r["weight"]
                 for r in body.get("ranker", {}).get("params", [])
             } if isinstance(body.get("ranker"), dict) else {},
+            # per-request cache bypass (SDK `cache=False`): forwarded
+            # so the PS-tier caches honor it too
+            "cache": body.get("cache", True) is not False,
         }
 
         lb = body.get("load_balance", "leader")
@@ -801,6 +901,7 @@ class RouterServer:
         from vearch_tpu.cluster.tracing import NULL_SPAN
 
         explicit_trace = bool(body.get("trace", False))
+        want_profile = bool(body.get("profile", False))
         root = (
             self.tracer.span(
                 "router.search",
@@ -814,68 +915,103 @@ class RouterServer:
             if root.ctx() is not None:
                 sub["trace"] = True  # sampled spans imply phase timings
 
-            import time as _time
+            # merged-result cache: consistent reads must see the log
+            # (raft_consistent), and trace:true promises per-partition
+            # timing that a hit cannot produce — both fall through to
+            # the scatter path. The entry validates against the per-
+            # partition apply versions recorded when it was computed.
+            cacheable = (
+                self.result_cache.max_entries > 0
+                and sub["cache"]
+                and not sub["raft_consistent"]
+                and not explicit_trace
+            )
+            pids = [p.id for p in space.partitions]
+            ckey = None
+            if cacheable:
+                from vearch_tpu.cluster.querycache import (
+                    canonical_query_key,
+                )
 
-            def timed(pid):
-                t0 = _time.time()
-                if root.ctx() is not None:
-                    span = self.tracer.span(
-                        "router.scatter", ctx=root.ctx(),
-                        tags={"partition": pid},
-                    )
-                    body_p = {**sub, "_trace_ctx": span.ctx()}
-                else:
-                    span, body_p = NULL_SPAN, sub
-                with span:
-                    r = self._call_partition(
-                        skey, pid, "/ps/doc/search", body_p, lb
-                    )
-                r["_rpc_ms"] = round((_time.time() - t0) * 1e3, 3)
-                return pid, r
+                ckey = canonical_query_key(
+                    "/".join(skey), vectors, k, {
+                        "filters": sub["filters"],
+                        "include_fields": sub["include_fields"],
+                        "columnar_wire": sub["columnar_wire"],
+                        "columnar": bool(body.get("columnar")),
+                        "sort": sub["sort"],
+                        "index_params": sub["index_params"],
+                        "score_bounds": sub["score_bounds"],
+                        "field_weights": sub["field_weights"],
+                        "page": [start, size],
+                        "load_balance": lb,
+                    },
+                )
+                with self._part_versions_lock:
+                    cur = {
+                        pid: self._part_versions.get(pid, -1)
+                        for pid in pids
+                    }
+                ent = self.result_cache.get(ckey, cur)
+                if ent is not None:
+                    return self._cache_response(
+                        ent, "hit", root, want_profile)
+            elif not sub["cache"]:
+                self.result_cache.note("bypass")
 
-            futures = [
-                self._pool.submit(timed, p.id) for p in space.partitions
-            ]
-            results = [f.result() for f in futures]
-            partials = [r for _, r in results]
-            t_merge = _time.time()
-            if sort_specs:
-                merged = self._merge_search_sorted(
-                    partials, sort_specs, k, start, size)
+            def compute():
+                out_core, results, merge_ms = self._search_scatter(
+                    skey, space, body, sub, sort_specs, k, start,
+                    size, lb, root,
+                )
+                if cacheable:
+                    # the entry's validity map comes from the partial
+                    # responses themselves — each PS stamped the apply
+                    # version it answered AT (captured before its
+                    # search ran, so a racing write labels the entry
+                    # older, never fresher)
+                    versions = {
+                        pid: r.get("apply_version") for pid, r in results
+                    }
+                    if (set(versions) == set(pids)
+                            and all(v is not None
+                                    for v in versions.values())):
+                        self.result_cache.put(
+                            ckey,
+                            {"out": out_core, "n": len(results)},
+                            {p: int(v) for p, v in versions.items()},
+                        )
+                return out_core, results, merge_ms
+
+            if cacheable:
+                (out_core, results, merge_ms), coalesced = (
+                    self._search_flight.do(ckey, compute)
+                )
+                if coalesced:
+                    self.result_cache.note("coalesced")
+                    return self._cache_response(
+                        {"out": out_core, "n": len(results)},
+                        "coalesced", root, want_profile)
+                cache_status = "miss"
             else:
-                merged = self._merge_search(partials, k)
-                # window slice within top-k (no-op without paging:
-                # start=0, size=k)
-                merged = [rows[start:start + size] for rows in merged]
-            if body.get("columnar") and body.get("fields") == []:
-                # opt-in columnar response: the client gets key lists +
-                # ONE flat f32 score buffer over the binary codec
-                # instead of b*k JSON dicts (the SDK reshapes, so its
-                # return type is unchanged)
-                import numpy as np
+                out_core, results, merge_ms = compute()
+                cache_status = (
+                    "bypass" if not sub["cache"] else "uncacheable")
 
-                out = {
-                    "columnar": True,
-                    "keys": [[r["_id"] for r in rows] for rows in merged],
-                    "scores": np.asarray(
-                        [r["_score"] for rows in merged for r in rows],
-                        dtype=np.float32,
-                    ),
-                }
-            else:
-                out = {"documents": merged}
+            out = dict(out_core)
+            root.set_tag("cache", cache_status)
             if root.trace_id:
                 # lets clients pull the span tree from /debug/traces on
                 # each role (reference: Jaeger trace id in responses)
                 out["trace_id"] = root.trace_id
-            if body.get("trace"):
+            if explicit_trace:
                 # per-partition timing breakdown (reference: trace:true
                 # response params, client/client.go:521-565)
                 out["params"] = {
                     str(pid): {"rpc_ms": r["_rpc_ms"], **r.get("timing", {})}
                     for pid, r in results
                 }
-            if body.get("profile"):
+            if want_profile:
                 # router-merged explain surface: each partition's
                 # structured phase/dispatch breakdown plus the router's
                 # own scatter RTT and merge cost
@@ -885,10 +1021,95 @@ class RouterServer:
                                    **(r.get("profile") or {})}
                         for pid, r in results
                     },
-                    "merge_ms": round((_time.time() - t_merge) * 1e3, 3),
+                    "merge_ms": merge_ms,
                     "partition_count": len(results),
+                    "cache": cache_status,
                 }
             return out
+
+    def _cache_response(self, ent: dict, status: str, root,
+                        want_profile: bool) -> dict:
+        """Shape a served-from-cache (or coalesced) response: the core
+        payload is shared with the entry, the envelope is fresh per
+        caller. The profile says explicitly that no partition work
+        happened for THIS response."""
+        out = dict(ent["out"])
+        root.set_tag("cache", status)
+        if root.trace_id:
+            out["trace_id"] = root.trace_id
+        if want_profile:
+            out["profile"] = {
+                "cache": status,
+                "partitions": {},
+                "partition_count": ent["n"],
+                "merge_ms": 0.0,
+            }
+        return out
+
+    def _search_scatter(self, skey, space, body, sub, sort_specs, k,
+                        start, size, lb, root):
+        """One real fan-out + merge pass: every partition is queried
+        and the partials merged. Returns (core response without the
+        per-request envelope, [(pid, partial)], merge_ms) — the caller
+        attaches trace_id/params/profile, and the cache stores only
+        the core. Kept as a seam: the coalescing tests stall exactly
+        this method to prove N callers share one scatter."""
+        import time as _time
+
+        from vearch_tpu.cluster.tracing import NULL_SPAN
+
+        def timed(pid):
+            t0 = _time.time()
+            if root.ctx() is not None:
+                span = self.tracer.span(
+                    "router.scatter", ctx=root.ctx(),
+                    tags={"partition": pid},
+                )
+                body_p = {**sub, "_trace_ctx": span.ctx()}
+            else:
+                span, body_p = NULL_SPAN, sub
+            with span:
+                r = self._call_partition(
+                    skey, pid, "/ps/doc/search", body_p, lb
+                )
+            # every partial carries the partition's apply version —
+            # feed the router's validity map even on plain searches
+            self._note_apply_version(pid, r.get("apply_version"))
+            r["_rpc_ms"] = round((_time.time() - t0) * 1e3, 3)
+            return pid, r
+
+        futures = [
+            self._pool.submit(timed, p.id) for p in space.partitions
+        ]
+        results = [f.result() for f in futures]
+        partials = [r for _, r in results]
+        t_merge = _time.time()
+        if sort_specs:
+            merged = self._merge_search_sorted(
+                partials, sort_specs, k, start, size)
+        else:
+            merged = self._merge_search(partials, k)
+            # window slice within top-k (no-op without paging:
+            # start=0, size=k)
+            merged = [rows[start:start + size] for rows in merged]
+        if body.get("columnar") and body.get("fields") == []:
+            # opt-in columnar response: the client gets key lists +
+            # ONE flat f32 score buffer over the binary codec
+            # instead of b*k JSON dicts (the SDK reshapes, so its
+            # return type is unchanged)
+            import numpy as np
+
+            out = {
+                "columnar": True,
+                "keys": [[r["_id"] for r in rows] for rows in merged],
+                "scores": np.asarray(
+                    [r["_score"] for rows in merged for r in rows],
+                    dtype=np.float32,
+                ),
+            }
+        else:
+            out = {"documents": merged}
+        return out, results, round((_time.time() - t_merge) * 1e3, 3)
 
     def _merge_search(
         self, partials: list[dict], k: int
@@ -1169,8 +1390,10 @@ class RouterServer:
                     by_partition.setdefault(pid, []).append(key)
 
             def send(pid: int, keys: list[str]):
-                return self._call_partition(skey, pid, "/ps/doc/delete",
-                                            {"keys": keys})
+                r = self._call_partition(skey, pid, "/ps/doc/delete",
+                                         {"keys": keys})
+                self._note_apply_version(pid, r.get("apply_version"))
+                return r
 
             futures = [
                 self._pool.submit(send, pid, keys)
@@ -1191,14 +1414,17 @@ class RouterServer:
                 out = self._call_partition(
                     skey, p.id, "/ps/doc/delete",
                     {"filters": body.get("filters"), "limit": remaining})
+                self._note_apply_version(p.id, out.get("apply_version"))
                 total += out["deleted"]
                 remaining -= out["deleted"]
             return {"total": total}
 
         def send_filter(pid: int):
             # no cap: the PS drains all matches
-            return self._call_partition(skey, pid, "/ps/doc/delete",
-                                        {"filters": body.get("filters")})
+            r = self._call_partition(skey, pid, "/ps/doc/delete",
+                                     {"filters": body.get("filters")})
+            self._note_apply_version(pid, r.get("apply_version"))
+            return r
 
         futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]
         return {"total": sum(f.result()["deleted"] for f in futures)}

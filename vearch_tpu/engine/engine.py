@@ -21,6 +21,7 @@ import tempfile
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -158,6 +159,22 @@ class Engine:
         # histograms through it; covers background auto-builds too)
         self.build_observer = None
         self._write_lock = threading.Lock()
+        # monotone data version: bumped under _write_lock by every
+        # mutation that can change search results (upsert, delete,
+        # schema/scalar-index changes). The serving caches key on it
+        # for exact invalidation — stale entries are unreachable the
+        # instant a write lands, and simply age out of their LRUs.
+        self.data_version = 0
+        # scalar-filter bitmap cache: (filter-json, data_version, n) ->
+        # combined alive∧filter mask, so repeated filtered searches
+        # skip both bitmap reconstruction and the columnar filter scan.
+        # Cached masks are served without a copy — callers treat
+        # `valid` as read-only (they already do).
+        self._filter_cache: OrderedDict[tuple, np.ndarray] = OrderedDict()
+        self._filter_cache_lock = threading.Lock()
+        self._filter_cache_max = 128
+        self.filter_cache_hits = 0
+        self.filter_cache_misses = 0
         # field -> in-flight build marker; stops the heartbeat reconcile
         # loop re-spawning a build every 2s while a long background build
         # has yet to publish (flags only flip at publish time), lets sync
@@ -294,6 +311,7 @@ class Engine:
                 self._scalar_manager.add_docs(
                     merged_docs, len(self.table._keys) - len(docs)
                 )
+            self.data_version += 1
         self._maybe_start_build()
         return keys
 
@@ -305,6 +323,8 @@ class Engine:
                 if docid is not None:
                     self.bitmap.set_deleted(docid)
                     n += 1
+            if n:
+                self.data_version += 1
         return n
 
     def get(
@@ -676,6 +696,7 @@ class Engine:
                     self._scalar_manager = ScalarIndexManager(self.schema)
                 self._scalar_manager.add_field(field, index)
                 f.scalar_index = itype  # dumps persist the new schema
+                self.data_version += 1
 
         def run() -> None:
             try:
@@ -721,6 +742,7 @@ class Engine:
             f.scalar_index = ScalarIndexType.NONE
             self.schema.fields.append(f)
             self.table.add_field(f)
+            self.data_version += 1
         if target is not ScalarIndexType.NONE:
             self.add_field_index(f.name, target.value)
 
@@ -736,6 +758,7 @@ class Engine:
             if self._scalar_manager is not None:
                 self._scalar_manager.remove_field(field)
             f.scalar_index = ScalarIndexType.NONE
+            self.data_version += 1
 
     def build_index(self, field_name: str | None = None,
                     op: str = "build") -> None:
@@ -919,6 +942,43 @@ class Engine:
                 return mb.submit(req)
         return self._search_direct(req)
 
+    def _filtered_mask(self, filters: Any, n: int) -> np.ndarray:
+        """Alive∧filter mask for the first `n` rows, cached on
+        (filter expression, data_version, n).
+
+        The version is captured BEFORE evaluation: a write landing
+        mid-evaluation bumps data_version, so the (possibly mixed)
+        mask stays keyed to the old version and the next search —
+        which reads the new version — recomputes. Searches concurrent
+        with the write get no weaker ordering than they had uncached.
+        """
+        from vearch_tpu.scalar.filter import evaluate_filter
+
+        version = self.data_version
+        try:
+            fkey = json.dumps(filters, sort_keys=True, default=str)
+        except (TypeError, ValueError):
+            fkey = None  # un-canonicalizable filter object: no caching
+        if fkey is not None:
+            key = (fkey, version, n)
+            with self._filter_cache_lock:
+                mask = self._filter_cache.get(key)
+                if mask is not None:
+                    self._filter_cache.move_to_end(key)
+                    self.filter_cache_hits += 1
+                    return mask
+                self.filter_cache_misses += 1
+        mask = self.bitmap.valid_mask(n) & evaluate_filter(
+            filters, self, n
+        )
+        if fkey is not None:
+            with self._filter_cache_lock:
+                self._filter_cache[key] = mask
+                self._filter_cache.move_to_end(key)
+                while len(self._filter_cache) > self._filter_cache_max:
+                    self._filter_cache.popitem(last=False)
+        return mask
+
     def _search_direct(self, req: SearchRequest) -> list[SearchResult]:
         if not req.vectors:
             raise ValueError("search needs at least one vector field")
@@ -943,11 +1003,7 @@ class Engine:
             t_start = _time.time()
             n = self.table.doc_count
             if req.filters is not None:
-                from vearch_tpu.scalar.filter import evaluate_filter
-
-                valid = self.bitmap.valid_mask(n) & evaluate_filter(
-                    req.filters, self, n
-                )
+                valid = self._filtered_mask(req.filters, n)
             else:
                 # no filter -> the alive mask only changes on writes;
                 # keep it device-resident so the hot path skips a

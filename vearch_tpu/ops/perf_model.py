@@ -112,6 +112,10 @@ DOCUMENTED_DISPATCHES: dict[str, list[str]] = {
     "ivfflat": ["ivfflat_scan"],
     # FLAT exact scan: one fused matmul+topk program
     "flat": ["flat_scan"],
+    # served from a result cache (router or PS tier): the whole point
+    # is ZERO device programs — the cache perf gates assert an empty
+    # ledger for hits and exactly one documented set per coalesced group
+    "cache_hit": [],
 }
 
 
@@ -258,6 +262,19 @@ INT8_PEAK_OPS: dict[str, float] = {
     "TPU v6e": 1836.0e12,
 }
 DEFAULT_CHIP = "TPU v5e"
+
+
+def effective_qps(
+    cold_qps: float, hit_rate: float, hit_cost_frac: float = 0.0
+) -> float:
+    """Amdahl-style serving throughput under a result cache: a hit
+    costs ``hit_cost_frac`` of a cold query (0 = free hash lookup),
+    a miss costs a full cold query. bench.py's cache-effectiveness
+    phase reports this next to the measured effective QPS so the
+    model and the measurement can be compared directly."""
+    hit_rate = min(max(hit_rate, 0.0), 1.0)
+    denom = hit_rate * max(hit_cost_frac, 0.0) + (1.0 - hit_rate)
+    return cold_qps / max(denom, 1e-12)
 
 
 def peak_int8_ops(device_kind: str | None) -> tuple[str, float]:

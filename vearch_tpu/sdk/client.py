@@ -97,6 +97,7 @@ class VearchClient:
         page_num: int | None = None,
         profile: bool = False,
         deadline_ms: float | None = None,
+        cache: bool = True,
     ) -> list[list[dict]] | dict:
         """Search `space_name`; returns per-query hit lists.
 
@@ -104,7 +105,12 @@ class VearchClient:
         ``documents`` plus a router-merged ``profile`` breakdown —
         per-partition phase timings, measured dispatch tags vs the perf
         model's documented prediction, and router merge cost (schema in
-        docs/OBSERVABILITY.md)."""
+        docs/OBSERVABILITY.md).
+
+        ``cache=False`` bypasses the router and partition result
+        caches for this request — correctness-sensitive callers and
+        cold benchmarks always hit the engines; the profile reports
+        ``cache: bypass``."""
         # features ride as ndarrays: the RPC layer's binary tensor codec
         # ships a [b*d] f32 buffer instead of tens of thousands of JSON
         # floats (a large-batch query upload was ~30% of e2e latency)
@@ -137,6 +143,8 @@ class VearchClient:
             # kill between device dispatches; an expired request fails
             # with a terminal request_killed error (never retried)
             body["deadline_ms"] = deadline_ms
+        if not cache:
+            body["cache"] = False
         if profile:
             body["profile"] = True
             return rpc.call(self.addr, "POST", "/document/search", body)
