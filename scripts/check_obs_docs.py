@@ -1,15 +1,20 @@
 #!/usr/bin/env python
 """Observability docs drift gate (tier-1 via tests/test_obs_docs.py).
 
-Extracts every metric registration and span name from the source tree
-and asserts docs/OBSERVABILITY.md documents exactly that set — both
-directions: an undocumented registration fails, and so does a
-documented name with no registration behind it (stale docs lie to the
-operator mid-incident, which is worse than no docs).
+Thin CLI over the lint framework's VL401 rule
+(vearch_tpu/tools/lint/rules_obs.py) — the extraction regexes and the
+bidirectional compare live THERE now, so `python -m
+vearch_tpu.tools.lint` and this script can never disagree about what
+counts as drift. Kept as a standalone entry point because CI and the
+docs reference it by path; DOC/SRC/source_names stay as module
+attributes because the gate's own tests patch them to prove the check
+is real.
 
-Names are compared after normalizing dynamic segments: an f-string
-`{tag}` in source and a `{tag}`/`<tag>` placeholder in the doc both
-become `*`.
+Asserts docs/OBSERVABILITY.md documents exactly the set of metric
+registrations and span names in the source tree — both directions: an
+undocumented registration fails, and so does a documented name with no
+registration behind it (stale docs lie to the operator mid-incident,
+which is worse than no docs).
 
 Run: python scripts/check_obs_docs.py   (exit 0 clean, 1 on drift)
 """
@@ -17,105 +22,33 @@ Run: python scripts/check_obs_docs.py   (exit 0 clean, 1 on drift)
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from vearch_tpu.tools.lint import rules_obs
+
 SRC = os.path.join(REPO, "vearch_tpu")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
-# registry.counter("name", ...) and friends, name possibly on the next
-# line. Matches call sites only (the quote right after the paren), not
-# the Registry method definitions.
-_METRIC_RE = re.compile(
-    r"\.(?:counter|gauge|histogram|callback_gauge|callback_counter)"
-    r"\(\s*[\"']([A-Za-z_][\w]*)[\"']",
-    re.S,
-)
 
-# span factories: tracer.span("name"...) / tracer.record("name"... or
-# f"raft.{event}"...); engine phase rows: phases.append(("name", ...)
-# or spans.append(["name"/f"kernel.{tag}", ...
-# post-creation span tags (`span.set_tag("cache", ...)`) mark
-# per-request facts the operator greps for mid-incident; every literal
-# key must appear backticked in the doc. One-directional: single-word
-# doc backticks are too generic to demand a registration behind each.
-_TAG_RE = re.compile(r"\.set_tag\(\s*[\"']([a-z_]+)[\"']")
-
-_SPAN_RES = [
-    re.compile(r"\.span\(\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
-    re.compile(r"\.record\(\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
-    re.compile(r"phases\.append\(\(\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
-    re.compile(r"spans\.append\(\[\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
-    re.compile(r"spans\.extend\(\s*\[\s*f?[\"']([a-z_.{}]+)[\"']", re.S),
-]
-
-
-def _normalize(name: str) -> str:
-    return re.sub(r"[{<][^}>]*[}>]", "*", name)
-
-
-def source_names() -> tuple[set[str], set[str], set[str]]:
-    metrics: set[str] = set()
-    spans: set[str] = set()
-    tags: set[str] = set()
-    for root, _dirs, files in os.walk(SRC):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            text = open(os.path.join(root, fn)).read()
-            metrics.update(_METRIC_RE.findall(text))
-            tags.update(_TAG_RE.findall(text))
-            for rx in _SPAN_RES:
-                spans.update(_normalize(n) for n in rx.findall(text))
-    return metrics, spans, tags
-
-
-def doc_names() -> tuple[set[str], set[str]]:
-    """Backticked tokens in the doc, split into metric-shaped
-    (prometheus identifier) and span-shaped (dotted) names. Prose
-    backticks (`trace: true`, file paths, field names) match neither
-    shape and are ignored."""
-    text = open(DOC).read()
-    metrics: set[str] = set()
-    spans: set[str] = set()
-    for tok in re.findall(r"`([^`\n]+)`", text):
-        if re.fullmatch(r"(?:vearch|tracing)_[a-z0-9_]+", tok):
-            metrics.add(tok)
-        elif re.fullmatch(r"[a-z_]+(?:\.[a-z_{}<>]+)+", tok):
-            spans.add(_normalize(tok))
-    return metrics, spans
+def source_names():
+    """(metrics, spans, tags) extracted from the source tree —
+    delegates to the lint rule's extractor."""
+    return rules_obs.source_names(SRC)
 
 
 def main() -> int:
-    src_metrics, src_spans, src_tags = source_names()
-    doc_metrics, doc_spans = doc_names()
-    doc_words = set(re.findall(r"`([a-z_]+)`", open(DOC).read()))
-    # keep only doc tokens whose first segment matches an emitted span
-    # family — drops dotted prose like `dispatches.tags` (a JSON field,
-    # not a span) without a hand-maintained prefix list
-    span_roots = {s.split(".", 1)[0] for s in src_spans}
-    doc_spans = {s for s in doc_spans if s.split(".", 1)[0] in span_roots}
-
-    failures = []
-    for name in sorted(src_metrics - doc_metrics):
-        failures.append(f"metric registered but undocumented: {name}")
-    for name in sorted(doc_metrics - src_metrics):
-        failures.append(f"metric documented but not registered: {name}")
-    for name in sorted(src_spans - doc_spans):
-        failures.append(f"span emitted but undocumented: {name}")
-    for name in sorted(doc_spans - src_spans):
-        failures.append(f"span documented but never emitted: {name}")
-    for name in sorted(src_tags - doc_words):
-        failures.append(f"span tag set but undocumented: {name}")
-
+    failures = rules_obs.drift_failures(*source_names(), DOC)
     if failures:
         print("docs/OBSERVABILITY.md drift detected:")
         for f in failures:
             print(f"  - {f}")
         return 1
-    print(f"obs docs in sync: {len(src_metrics)} metrics, "
-          f"{len(src_spans)} span families, {len(src_tags)} span tags")
+    metrics, spans, tags = source_names()
+    print(f"obs docs in sync: {len(metrics)} metrics, "
+          f"{len(spans)} span families, {len(tags)} span tags")
     return 0
 
 

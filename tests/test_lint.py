@@ -1,0 +1,372 @@
+"""vearch-lint + lockcheck gate (static-analysis tentpole).
+
+Two halves:
+
+- the package gate: `python -m vearch_tpu.tools.lint vearch_tpu/`
+  exits 0 against the checked-in allowlist — project invariants hold
+  on every commit, in tier-1;
+- planted-violation fixtures: each rule (and the runtime lock-order
+  detector) demonstrably FIRES on seeded bad code, so a regression in
+  the analyzer itself cannot silently turn the gate into a tautology.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import textwrap
+
+import pytest
+
+from vearch_tpu.tools import lockcheck
+from vearch_tpu.tools.lint.core import Allowlist, run_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "vearch_tpu")
+
+
+def _lint_file(tmp_path, rel, source, allowlist=None):
+    """Write `source` at tmp_path/rel and lint it; returns unsuppressed
+    findings. `rel` matters: path-suffix rules (VL102, VL302) key on it."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    findings = run_paths([str(path)], allowlist=allowlist)
+    return [f for f in findings if not f.suppressed]
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- the real gate -----------------------------------------------------------
+
+def test_package_is_lint_clean():
+    """The tree passes its own linter with the checked-in allowlist.
+    Run as a subprocess so the exact CI/dev command is what's proven."""
+    out = subprocess.run(
+        [sys.executable, "-m", "vearch_tpu.tools.lint", PKG],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 finding(s)" in out.stdout
+
+
+def test_list_rules_names_every_rule():
+    out = subprocess.run(
+        [sys.executable, "-m", "vearch_tpu.tools.lint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0
+    for rid in ("VL101", "VL102", "VL201", "VL202", "VL203",
+                "VL301", "VL302", "VL401"):
+        assert rid in out.stdout, rid
+
+
+# -- planted static violations: every rule fires -----------------------------
+
+def test_vl101_hidden_dispatch_fires(tmp_path):
+    found = _lint_file(tmp_path, "cluster/sneaky.py", """\
+        import jax
+
+        def warm(fn):
+            return jax.jit(fn)
+        """)
+    assert _rules(found) == ["VL101"]
+
+
+def test_vl101_silent_in_device_layers(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/ops/fine.py", """\
+        import jax
+
+        def warm(fn):
+            return jax.jit(fn)
+        """)
+    assert found == []
+
+
+def test_vl102_host_sync_in_serving_path_fires(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        import numpy as np
+
+        class PSServer:
+            def _h_search(self, body):
+                q = np.asarray(body["vectors"])
+                return q
+
+            def _h_other(self, body):
+                return np.asarray(body)  # not a serving-path function
+        """)
+    assert _rules(found) == ["VL102"]
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_vl102_inline_allow_suppresses(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        import numpy as np
+
+        class PSServer:
+            def _h_search(self, body):
+                q = np.asarray(body["vectors"])  # lint: allow[host-sync] wire payload normalization
+                return q
+        """)
+    assert found == []
+
+
+def test_vl201_unguarded_mutation_fires(tmp_path):
+    found = _lint_file(tmp_path, "store.py", """\
+        import threading
+
+        class Store:
+            _guarded_by = {"items": "_lock", "count": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+                self.count = 0  # __init__ is exempt
+
+            def good(self, k, v):
+                with self._lock:
+                    self.items[k] = v
+                    self.count += 1
+
+            def bad(self, k):
+                self.items.pop(k, None)
+                self.count -= 1
+        """)
+    assert _rules(found) == ["VL201"]
+    assert len(found) == 2  # .pop() and the augmented assignment
+
+
+def test_vl201_holds_pragma_trusted(tmp_path):
+    found = _lint_file(tmp_path, "store.py", """\
+        import threading
+
+        class Store:
+            _guarded_by = {"count": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump_locked(self):  # lint: holds[_lock]
+                self.count += 1
+        """)
+    assert found == []
+
+
+def test_vl202_anonymous_thread_fires(tmp_path):
+    found = _lint_file(tmp_path, "bg.py", """\
+        import threading
+
+        def go(fn):
+            threading.Thread(target=fn, daemon=True).start()
+            threading.Thread(target=fn, daemon=True, name="ok").start()
+        """)
+    assert _rules(found) == ["VL202"]
+    assert len(found) == 1 and found[0].line == 4
+
+
+def test_vl203_wall_clock_fires_and_monotonic_passes(tmp_path):
+    found = _lint_file(tmp_path, "timing.py", """\
+        import time
+        import time as _time
+
+        def latency():
+            t0 = time.time()
+            t1 = _time.time()
+            t2 = time.monotonic()
+            return t0, t1, t2
+        """)
+    assert _rules(found) == ["VL203"]
+    assert sorted(f.line for f in found) == [5, 6]
+
+
+def test_vl301_bare_except_fires(tmp_path):
+    found = _lint_file(tmp_path, "anything.py", """\
+        def f():
+            try:
+                return 1
+            except:
+                return None
+        """)
+    assert _rules(found) == ["VL301"]
+
+
+def test_vl302_swallowed_except_in_raft_fires(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/raft.py", """\
+        def apply(entries, log):
+            for e in entries:
+                try:
+                    e()
+                except Exception:
+                    pass
+                try:
+                    e()
+                except Exception as exc:  # visible: logged
+                    log.warning("apply failed: %s", exc)
+        """)
+    assert _rules(found) == ["VL302"]
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_vl302_only_in_critical_modules(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/router.py", """\
+        def f(e):
+            try:
+                e()
+            except Exception:
+                pass
+        """)
+    assert found == []
+
+
+def test_reasonless_pragma_is_itself_a_finding(tmp_path):
+    found = _lint_file(tmp_path, "timing.py", """\
+        import time
+
+        def f():
+            return time.time()  # lint: allow[wall-clock]
+        """)
+    # the naked pragma suppresses the site but fails the gate itself:
+    # VL000 is unsuppressable, so a reasonless waiver still exits 1
+    assert _rules(found) == ["VL000"]
+    assert "no reason" in found[0].message
+
+
+def test_allowlist_suppresses_and_unused_entries_fail(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "VL203 cluster/timing.py fixture proves file-scoped suppression\n"
+        "VL101 cluster/gone.py this entry matches nothing\n"
+    )
+    found = _lint_file(tmp_path, "cluster/timing.py", """\
+        import time
+
+        def f():
+            return time.time()
+        """, allowlist=Allowlist(str(allow)))
+    # VL203 suppressed by the first entry; the dead entry is a VL000
+    assert _rules(found) == ["VL000"]
+    assert "unused allowlist entry" in found[0].message
+
+
+# -- runtime lockcheck: the dynamic half -------------------------------------
+
+@pytest.fixture
+def lockcheck_on():
+    lockcheck.reset()
+    lockcheck.enable()
+    yield
+    lockcheck.reset()
+
+
+def test_make_lock_is_plain_when_disabled():
+    lockcheck.reset()
+    lockcheck.disable()
+    try:
+        lk = lockcheck.make_lock("x")
+        assert not isinstance(lk, lockcheck.DebugLock)
+    finally:
+        lockcheck.reset()
+
+
+def test_lock_order_inversion_detected(lockcheck_on):
+    a = lockcheck.make_lock("fixture.a")
+    b = lockcheck.make_lock("fixture.b")
+    with a:
+        with b:
+            pass
+    # reverse order on another thread — no deadlock is ever hit, the
+    # edge graph alone proves the interleaving exists
+    def reverse():
+        with b:
+            with a:
+                pass
+    t = threading.Thread(target=reverse, daemon=True, name="fixture-rev")
+    t.start()
+    t.join(timeout=10)
+    kinds = [v["kind"] for v in lockcheck.violations()]
+    assert "lock-order-inversion" in kinds
+    with pytest.raises(AssertionError, match="lock-order-inversion"):
+        lockcheck.check()
+
+
+def test_consistent_order_is_clean(lockcheck_on):
+    a = lockcheck.make_lock("fixture.c")
+    b = lockcheck.make_lock("fixture.d")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    lockcheck.check()
+    assert (("fixture.c", "fixture.d")
+            in lockcheck.acquisition_edges())
+
+
+def test_unguarded_write_detected(lockcheck_on):
+    @lockcheck.guarded
+    class Store:
+        _guarded_by = {"count": "_lock"}
+
+        def __init__(self):
+            self._lock = lockcheck.make_lock("fixture.store")
+            self.count = 0  # construction is exempt
+
+    s = Store()
+    with s._lock:
+        s.count = 1  # guarded: fine
+    s.count = 2  # seeded violation
+    kinds = [v["kind"] for v in lockcheck.violations()]
+    assert kinds == ["unguarded-write"]
+    assert "Store.count" in lockcheck.violations()[0]["detail"]
+
+
+def test_non_reentrant_reacquire_detected(lockcheck_on):
+    lk = lockcheck.make_lock("fixture.plain", reentrant=False)
+    with lk:
+        with lk:  # a real Lock would deadlock right here
+            pass
+    kinds = [v["kind"] for v in lockcheck.violations()]
+    assert "self-deadlock" in kinds
+
+
+def test_foreign_release_detected(lockcheck_on):
+    lk = lockcheck.make_lock("fixture.foreign", reentrant=True)
+    lk.acquire()
+    err: list[Exception] = []
+
+    def releaser():
+        try:
+            lk.release()
+        except Exception as e:  # RLock may refuse; the record is the point
+            err.append(e)
+
+    t = threading.Thread(target=releaser, daemon=True,
+                         name="fixture-foreign")
+    t.start()
+    t.join(timeout=10)
+    kinds = [v["kind"] for v in lockcheck.violations()]
+    assert "foreign-release" in kinds
+
+
+def test_condition_integration_keeps_held_stack_honest(lockcheck_on):
+    lk = lockcheck.make_lock("fixture.cv", reentrant=True)
+    cv = threading.Condition(lk)
+    ready = threading.Event()
+
+    def waiter():
+        with cv:
+            ready.set()
+            cv.wait(timeout=10)
+            # back under the lock after wait: guarded writes here must
+            # see the lock as held
+            assert lk.held_by_current()
+
+    t = threading.Thread(target=waiter, daemon=True, name="fixture-cv")
+    t.start()
+    assert ready.wait(timeout=10)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=10)
+    lockcheck.check()

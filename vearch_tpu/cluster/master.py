@@ -459,7 +459,8 @@ class MasterServer:
                     # — run it off-thread so heartbeats keep flowing, and
                     # retry while leadership holds
                     threading.Thread(target=self._on_promoted,
-                                     daemon=True).start()
+                                     daemon=True,
+                                     name="master-promote").start()
                 self._was_leader = leader_now
                 # periodic meta checkpoint + log truncation
                 node = self.meta_node
@@ -510,10 +511,10 @@ class MasterServer:
                 # invalidations would be silently lost
                 return {"rev": self._watch_rev, "epoch": self._watch_epoch,
                         "reset": True, "keys": []}
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._watch_cond:
             while self._watch_rev <= rev and not self._stop.is_set():
-                remain = deadline - time.time()
+                remain = deadline - time.monotonic()
                 if remain <= 0:
                     break
                 self._watch_cond.wait(min(remain, 1.0))
@@ -535,10 +536,11 @@ class MasterServer:
 
     def start(self) -> None:
         self.server.start()
-        threading.Thread(target=self._lease_reaper, daemon=True).start()
+        threading.Thread(target=self._lease_reaper, daemon=True,
+                         name="master-lease-reaper").start()
         if self.auto_recover:
             threading.Thread(target=self._auto_recover_loop,
-                             daemon=True).start()
+                             daemon=True, name="master-auto-recover").start()
         if self.join_addr and len(self.peers) <= 1:
             # register with the existing group (any member forwards the
             # POST to the leader); the response carries the full member
@@ -558,7 +560,7 @@ class MasterServer:
                     self.meta_node.members = sorted(self.peers)
         if self.replicated:
             threading.Thread(target=self._election_loop,
-                             daemon=True).start()
+                             daemon=True, name="master-election").start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -585,7 +587,7 @@ class MasterServer:
                         # master_cache.go:963-1005) + immediate failover
                         node_id = int(key[len(PREFIX_SERVER):])
                         self.store.put(f"/fail_server/{node_id}", {
-                            "node_id": node_id, "time": time.time(),
+                            "node_id": node_id, "time": time.time(),  # lint: allow[wall-clock] durable failure stamp read across master restarts
                         })
                         # drop its last heartbeat stats: serving a dead
                         # node's doc/size report as current (via the
@@ -698,7 +700,7 @@ class MasterServer:
         # rebuilt); leaderless reconciliation below runs regardless
         fails = self.store.prefix("/fail_server/")
         may_replace = not any(
-            time.time() - v["time"] < self.recover_delay
+            time.time() - v["time"] < self.recover_delay  # lint: allow[wall-clock] compares against the durable fail stamp, same clock
             for v in fails.values()
         )
         for key, sp in self.store.prefix(PREFIX_SPACE).items():
@@ -893,7 +895,7 @@ class MasterServer:
         if lease is None or not self.store.keepalive(lease, ttl):
             lease = self.store.grant_lease(ttl)
             leases[addr] = lease
-            self.store.put(key, {"addr": addr, "register_time": time.time()},
+            self.store.put(key, {"addr": addr, "register_time": time.time()},  # lint: allow[wall-clock] operator-facing registration stamp
                            lease=lease)
         return {"addr": addr}
 
@@ -1133,7 +1135,7 @@ class MasterServer:
         self.store.put(key, {**rec, "time": 0.0})
         others_fresh = any(
             int(k.rsplit("/", 1)[1]) != node_id
-            and time.time() - v["time"] < self.recover_delay
+            and time.time() - v["time"] < self.recover_delay  # lint: allow[wall-clock] compares against the durable fail stamp, same clock
             for k, v in self.store.prefix("/fail_server/").items()
         )
         with self._reconfig_lock:
@@ -1219,7 +1221,7 @@ class MasterServer:
             db = parts[0]
             if self.store.get(f"{PREFIX_DB}{db}") is not None:
                 raise RpcError(409, f"db {db} exists")
-            self.store.put(f"{PREFIX_DB}{db}", {"name": db, "create_time": time.time()})
+            self.store.put(f"{PREFIX_DB}{db}", {"name": db, "create_time": time.time()})  # lint: allow[wall-clock] operator-facing creation stamp
             return {"name": db}
         if len(parts) == 2 and parts[1] == "spaces":
             return self._create_space(parts[0], body)
@@ -1699,8 +1701,8 @@ class MasterServer:
         job_id = f"{db}:{name}:v{version}"
         job = {
             "job_id": job_id, "db": db, "space": name, "version": version,
-            "status": "running", "started": time.time(),
-            "updated": time.time(), "error": None,
+            "status": "running", "started": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+            "updated": time.time(), "error": None,  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
             "partitions": {}, "results": [],
         }
         shards = []
@@ -1758,8 +1760,8 @@ class MasterServer:
                         with self._backup_jobs_lock:
                             pj["status"] = "error"
                             pj["error"] = e.msg
-                deadline = time.time() + job_timeout
-                while running and time.time() < deadline:
+                deadline = time.monotonic() + job_timeout
+                while running and time.monotonic() < deadline:
                     # keep the space lock alive for the job's real
                     # duration (same-owner try_lock refreshes the TTL):
                     # a long upload must not let the lock lapse while
@@ -1786,7 +1788,7 @@ class MasterServer:
                             elif st["status"] == "error":
                                 pj["error"] = st.get("error")
                                 del running[pid_]
-                            job["updated"] = time.time()
+                            job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
                     # CLI refreshes at 0.5s; polling much faster only
                     # burns RPCs (review r5)
                     time.sleep(0.25)
@@ -1805,12 +1807,12 @@ class MasterServer:
                             str(p.get("error")) for p in errs)
                     else:
                         job["status"] = "done"
-                    job["updated"] = time.time()
+                    job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
             except Exception as e:  # job record must never stick "running"
                 with self._backup_jobs_lock:
                     job.update(status="error",
                                error=f"{type(e).__name__}: {e}",
-                               updated=time.time())
+                               updated=time.time())  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
             finally:
                 if not shards_still_running:
                     self.store.unlock(lock_name, lock_owner)

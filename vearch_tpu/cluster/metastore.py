@@ -145,7 +145,7 @@ class MetaStore:
         with self._lock:
             lease = self._next_lease
             self._next_lease += 1
-            self._leases[lease] = (time.time() + ttl_s, [])
+            self._leases[lease] = (time.monotonic() + ttl_s, [])
             return lease
 
     def revoke_lease(self, lease: int) -> None:
@@ -159,7 +159,7 @@ class MetaStore:
         with self._lock:
             if lease not in self._leases:
                 return False
-            self._leases[lease] = (time.time() + ttl_s, self._leases[lease][1])
+            self._leases[lease] = (time.monotonic() + ttl_s, self._leases[lease][1])
             return True
 
     def expire_leases(self) -> list[str]:
@@ -167,7 +167,7 @@ class MetaStore:
         failure-detection tick — reference: lease expiry fires the
         server-watch DELETE, master_cache.go:963). The deletions
         replicate through the log like any other mutation."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             dead = [lid for lid, (exp, _) in self._leases.items() if exp < now]
             doomed: list[str] = []
@@ -183,11 +183,11 @@ class MetaStore:
     def try_lock(self, name: str, owner: str, ttl_s: float = 30.0) -> bool:
         with self._lock:
             cur = self._locks.get(name)
-            if cur is not None and cur["expiry"] > time.time() \
+            if cur is not None and cur["expiry"] > time.monotonic() \
                     and cur["owner"] != owner:
                 return False
             self._locks[name] = {"owner": owner,
-                                 "expiry": time.time() + ttl_s}
+                                 "expiry": time.monotonic() + ttl_s}
             return True
 
     def unlock(self, name: str, owner: str) -> None:
@@ -201,7 +201,7 @@ class MetaStore:
         the sweep cannot race a concurrent try_lock re-acquiring a name
         it just judged expired."""
         with self._lock:
-            now = time.time()
+            now = time.monotonic()
             cleaned = [n for n, c in self._locks.items()
                        if c["expiry"] <= now]
             for n in cleaned:

@@ -10,8 +10,11 @@ auto-instruments request count + latency per (method, path, code).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Iterable
+
+_log = logging.getLogger("vearch.internal")
 
 _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
@@ -179,9 +182,42 @@ class Registry:
             self._metrics.append(m)
         return m
 
+    def attach(self, metric) -> None:
+        """Expose an externally-owned metric (e.g. the process-wide
+        internal-error counter) on this registry's /metrics page."""
+        with self._lock:
+            if metric not in self._metrics:
+                self._metrics.append(metric)
+
     def render(self) -> str:
         with self._lock:
             return "\n".join(m.render() for m in self._metrics) + "\n"
+
+
+# process-wide swallowed-exception counter (lint rule VL302: a broad
+# except in a replication-critical path must raise, log, or count).
+# Lives outside any server's registry — raft nodes and WALs are not
+# servers — and is attach()ed to every JsonRpcServer registry so each
+# role's /metrics page exposes it.
+_internal_registry = Registry()
+INTERNAL_ERRORS = _internal_registry.counter(
+    "vearch_internal_errors_total",
+    "exceptions deliberately swallowed at non-fatal sites, by site",
+    ("site",))
+
+
+def internal_error(site: str, exc: BaseException | None = None) -> None:
+    """Count + log an exception a caller chose not to propagate.
+
+    The contract for 'this failure must not break the caller' paths
+    (observer hooks, best-effort notifications): swallowing is allowed
+    only if the event is counted per site and logged — a replica that
+    diverges silently is the incident the obs stack exists to catch.
+    """
+    INTERNAL_ERRORS.inc(site)
+    if exc is not None:
+        _log.warning("internal error at %s: %s: %s",
+                     site, type(exc).__name__, exc)
 
 
 def register_tracer_metrics(registry: "Registry", tracer) -> None:

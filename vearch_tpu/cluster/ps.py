@@ -35,6 +35,7 @@ from vearch_tpu.cluster.rpc import (
     JsonRpcServer,
     RpcError,
 )
+from vearch_tpu.tools import lockcheck
 from vearch_tpu.utils import log
 
 _log = log.get("ps")
@@ -95,7 +96,31 @@ def _write_profile_from_timing(timing: dict) -> dict:
     return out
 
 
+@lockcheck.guarded
 class PSServer:
+    # lock discipline (lint VL201 + runtime lockcheck): the partition
+    # registries mutate under _lock; the in-flight request registry and
+    # its kill counter under _inflight_lock; async backup jobs under
+    # _backup_jobs_lock; the small hot-path caches/counters under a
+    # dedicated _stats_lock so stats updates never contend with
+    # partition registry operations.
+    _guarded_by = {
+        "engines": "_lock",
+        "partitions": "_lock",
+        "raft_nodes": "_lock",
+        "_flushed": "_lock",
+        "_flush_locks": "_lock",
+        "_inflight": "_inflight_lock",
+        "killed_requests": "_inflight_lock",
+        "_backup_jobs": "_backup_jobs_lock",
+        "_peer_cache": "_stats_lock",
+        "_mem_cache": "_stats_lock",
+        "_mem_dirty": "_stats_lock",
+        "replication_errors": "_stats_lock",
+        "slow_routed": "_stats_lock",
+        "_search_ewma": "_stats_lock",
+    }
+
     def __init__(
         self,
         data_dir: str,
@@ -126,8 +151,8 @@ class PSServer:
         # one checkpoint at a time per partition: concurrent flushes
         # (flush loop + /ps/flush + snapshot sends) would interleave
         # writes to the same snapshot files
-        self._flush_locks: dict[int, threading.Lock] = {}
-        self._lock = threading.Lock()
+        self._flush_locks: dict[int, Any] = {}
+        self._lock = lockcheck.make_lock("ps._lock")
         self.master_addr = master_addr
         # service credentials for master calls when the cluster runs with
         # auth (replication metadata reads would otherwise 401 silently)
@@ -156,6 +181,9 @@ class PSServer:
         # default-denied — a confined operator setup must not be
         # escapable by just switching store types (exfiltration/SSRF)
         self.backup_endpoints = backup_endpoints
+        # small hot-path counters/caches (guard map above) — their own
+        # lock so stats writes never queue behind registry operations
+        self._stats_lock = lockcheck.make_lock("ps._stats_lock")
         self.replication_errors = 0  # surfaced in /ps/stats
         # topology labels (host/rack/zone) for placement anti-affinity
         self.labels = dict(labels or {})
@@ -164,10 +192,11 @@ class PSServer:
         # Rqueue registration for kill + ps/schedule_job.go:252 slow-
         # request killer). 0 disables the automatic killer.
         self._inflight: dict[str, dict] = {}
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = lockcheck.make_lock("ps._inflight_lock")
         # async shard-backup jobs (reference: PSShardManager state)
         self._backup_jobs: dict[str, dict] = {}
-        self._backup_jobs_lock = threading.Lock()
+        self._backup_jobs_lock = lockcheck.make_lock(
+            "ps._backup_jobs_lock")
         self.slow_request_ms = 0
         self.killed_requests = 0
         # per-request deadline default (ms); a search may override via
@@ -463,10 +492,14 @@ class PSServer:
             self._register()
         self._recover_partitions()
         if self.master_addr:
-            threading.Thread(target=self._heartbeat_loop, daemon=True).start()
-        threading.Thread(target=self._flush_loop, daemon=True).start()
-        threading.Thread(target=self._raft_tick_loop, daemon=True).start()
-        threading.Thread(target=self._slow_killer_loop, daemon=True).start()
+            threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name="ps-heartbeat").start()
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name="ps-flush").start()
+        threading.Thread(target=self._raft_tick_loop, daemon=True,
+                         name="ps-raft-tick").start()
+        threading.Thread(target=self._slow_killer_loop, daemon=True,
+                         name="ps-slow-killer").start()
 
     def stop(self, flush: bool = True) -> None:
         self._stop.set()
@@ -652,9 +685,13 @@ class PSServer:
                     with open(ap) as f:
                         applied = int(json.load(f)["applied"])
                 node = self._make_raft_node(part, pdir)
-                node.applied = applied
-                self._flushed[pid] = applied
+                # lock-fix note: applied is raft-lock-guarded state and
+                # _flushed was written outside _lock — both race the
+                # flush loop once earlier partitions started it
+                with node._lock:
+                    node.applied = applied
                 with self._lock:
+                    self._flushed[pid] = applied
                     self.engines[pid] = eng
                     self.partitions[pid] = part
                     self.raft_nodes[pid] = node
@@ -732,7 +769,8 @@ class PSServer:
         eng = self._engine(pid)
         t = op["type"]
         if t == "upsert":
-            self._mem_dirty = True  # cached memory accounting is stale
+            with self._stats_lock:
+                self._mem_dirty = True  # cached memory accounting is stale
             try:
                 return eng.upsert(op["documents"])
             except ValueError as e:
@@ -744,18 +782,22 @@ class PSServer:
                 # marker on every replica, so determinism holds.
                 return {"_rejected": str(e)}
         if t == "delete":
-            self._mem_dirty = True
+            with self._stats_lock:
+                self._mem_dirty = True
             return eng.delete(op["keys"])
         raise RpcError(500, f"unknown log op {t!r}")
 
     def _peer_addr(self, peer: int) -> str:
-        now = time.time()
+        now = time.monotonic()  # cache TTL is a duration
         ts, cache = self._peer_cache
         if now - ts > 2.0 or peer not in cache:
             servers = rpc.call(self.master_addr, "GET", "/servers",
                                auth=self.master_auth)["servers"]
             cache = {s["node_id"]: s["rpc_addr"] for s in servers}
-            self._peer_cache = (now, cache)
+            # lock-fix note: concurrent refreshers raced the rebind;
+            # last-writer-wins is fine but the write itself is guarded
+            with self._stats_lock:
+                self._peer_cache = (now, cache)
         if peer not in cache:
             raise RpcError(503, f"no address for node {peer}")
         return cache[peer]
@@ -765,7 +807,10 @@ class PSServer:
             return rpc.call(self._peer_addr(peer), "POST", path, body,
                             timeout=30.0)
         except RpcError:
-            self.replication_errors += 1
+            # lock-fix note: unlocked += from concurrent sync threads
+            # dropped increments (read-modify-write race)
+            with self._stats_lock:
+                self.replication_errors += 1
             raise
 
     def _node(self, pid: int) -> RaftNode:
@@ -846,6 +891,16 @@ class PSServer:
                     _log.error("ps %s: flush partition %s failed: %s: %s",
                                self.node_id, pid, type(e).__name__, e)
 
+    def _flush_lock(self, pid: int):
+        # lock-fix note: flush locks were minted via bare setdefault
+        # from the flush loop, /ps/flush, snapshot sends and restore
+        # concurrently — two callers could each get a DIFFERENT lock
+        # for the same pid and checkpoint over each other. The dict
+        # mutation now happens under _lock.
+        with self._lock:
+            return self._flush_locks.setdefault(
+                pid, lockcheck.make_lock(f"ps.flush{pid}"))
+
     def flush_partition(self, pid: int) -> int:
         """Checkpoint the engine with its applied index, then truncate
         the WAL behind it (keeping a catch-up tail). Returns the flushed
@@ -853,7 +908,7 @@ class PSServer:
         node = self._node(pid)
         eng = self._engine(pid)
         pdir = os.path.join(self.data_dir, f"partition_{pid}")
-        with self._flush_locks.setdefault(pid, threading.Lock()):
+        with self._flush_lock(pid):
             # capture under the apply mutex so the engine snapshot
             # matches node.applied exactly; disk writes happen outside
             # it (but inside the flush lock — one checkpoint at a time)
@@ -867,7 +922,10 @@ class PSServer:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, os.path.join(pdir, "applied.json"))
-            self._flushed[pid] = applied
+            # lock-fix note: _flushed is read by the flush loop under
+            # no lock at all; writes now consistently go through _lock
+            with self._lock:
+                self._flushed[pid] = applied
             node.wal.save_meta(fsync=True)
             node.wal.truncate_prefix(
                 max(node.wal.first_index, applied - WAL_KEEP_ENTRIES + 1)
@@ -915,8 +973,9 @@ class PSServer:
         self._wire_engine(pid, eng)
         with self._lock:
             self.engines[pid] = eng
-        self._flushed[pid] = snap_index
-        self._mem_dirty = True
+            self._flushed[pid] = snap_index
+        with self._stats_lock:
+            self._mem_dirty = True
 
     # -- handlers ------------------------------------------------------------
 
@@ -932,7 +991,7 @@ class PSServer:
         _mem_max_age seconds; applies mark it dirty, and a dirty read
         refreshes at most every _mem_min_interval seconds so a write
         burst pays one O(engines) walk per interval, not per request."""
-        now = time.time()
+        now = time.monotonic()  # cache age is a duration
         ts, val = self._mem_cache
         age = now - ts
         if (age > self._mem_max_age
@@ -940,8 +999,12 @@ class PSServer:
             val = sum(
                 e.memory_usage_bytes() for e in list(self.engines.values())
             )
-            self._mem_cache = (now, val)
-            self._mem_dirty = False
+            # the O(engines) walk stays outside the lock (concurrent
+            # refreshers waste a walk, never corrupt); the cache rebind
+            # + dirty-flag clear are what must be atomic
+            with self._stats_lock:
+                self._mem_cache = (now, val)
+                self._mem_dirty = False
         return val
 
     def _wire_engine(self, pid: int, eng: Engine) -> None:
@@ -1160,7 +1223,9 @@ class PSServer:
             time.sleep(max(0.05, min(0.5,
                                      (self.slow_request_ms or 2000) / 4000.0)))
             limit = self.slow_request_ms
-            now = time.time()
+            # monotonic, matching the request start stamps: a clock
+            # step must not mass-kill (or never kill) in-flight work
+            now = time.monotonic()
             with self._inflight_lock:
                 for rid, info in self._inflight.items():
                     ctx = info["ctx"]
@@ -1199,7 +1264,7 @@ class PSServer:
         return {"request_id": rid, "killed": killed}
 
     def _h_requests(self, _body, _parts) -> dict:
-        now = time.time()
+        now = time.monotonic()  # elapsed_ms against monotonic starts
         with self._inflight_lock:
             return {"requests": [
                 {"request_id": i["rid"],
@@ -1237,7 +1302,7 @@ class PSServer:
         eng = self._engine(body["partition_id"])
         self._check_read_consistency(body)
         vectors = {
-            name: np.asarray(v, dtype=np.float32)
+            name: np.asarray(v, dtype=np.float32)  # lint: allow[host-sync] host-side input normalization of wire payloads, no device work exists yet
             for name, v in body["vectors"].items()
         }
         pid = int(body["partition_id"])
@@ -1249,15 +1314,16 @@ class PSServer:
         )
         gate = self._slow_gate if slow else self._search_gate
         if slow:
-            self.slow_routed += 1
-        t_gate = time.time()
+            with self._stats_lock:
+                self.slow_routed += 1
+        t_gate = time.monotonic()
         if not gate.acquire(timeout=30.0):
             raise RpcError(
                 429,
                 "partition server %s queue full"
                 % ("slow-search" if slow else "search"),
             )
-        gate_wait_ms = round((time.time() - t_gate) * 1e3, 3)
+        gate_wait_ms = round((time.monotonic() - t_gate) * 1e3, 3)
         rid = str(body.get("request_id") or uuid.uuid4().hex)
         token = uuid.uuid4().hex  # unique even when clients reuse rids
         # per-request deadline: the search option wins, else the PS-wide
@@ -1265,7 +1331,9 @@ class PSServer:
         deadline_ms = float(
             body.get("deadline_ms") or self.request_deadline_ms or 0
         )
-        t_start = time.time()
+        t_start = time.monotonic()
+        # wall anchor for span epochs; all measurement stays monotonic
+        wall0 = time.time() - t_start  # lint: allow[wall-clock] span epoch anchor, correlates with collector time
         ctx = RequestContext(
             rid,
             deadline=(t_start + deadline_ms / 1e3) if deadline_ms else None,
@@ -1318,7 +1386,7 @@ class PSServer:
                         sctx = span.ctx()
                         self.tracer.record(
                             "ps.gate_wait", ctx=sctx,
-                            start_us=int(t_gate * 1e6),
+                            start_us=int((wall0 + t_gate) * 1e6),
                             dur_us=int(gate_wait_ms * 1e3),
                             tags={"partition": pid},
                         )
@@ -1352,8 +1420,8 @@ class PSServer:
             if span is NULL_SPAN:
                 self.tracer.record(
                     "ps.search",
-                    start_us=int(t_start * 1e6),
-                    dur_us=int((time.time() - t_start) * 1e6),
+                    start_us=int((wall0 + t_start) * 1e6),
+                    dur_us=int((time.monotonic() - t_start) * 1e6),
                     tags={"partition": pid, "request_id": rid,
                           "kill_reason": reason},
                     status="error: RequestKilled",
@@ -1366,11 +1434,13 @@ class PSServer:
             with self._inflight_lock:
                 self._inflight.pop(token, None)
             gate.release()
-            # EWMA update outside the lock: a lost update under a race
-            # only slows convergence
-            ms = (time.time() - t_start) * 1e3
-            prev = self._search_ewma.get(pid, ms)
-            self._search_ewma[pid] = 0.8 * prev + 0.2 * ms
+            ms = (time.monotonic() - t_start) * 1e3
+            # lock-fix note: the EWMA read-modify-write was documented
+            # as benignly racy, but a torn read-modify-write pair can
+            # resurrect a stale latency forever — _stats_lock is cheap
+            with self._stats_lock:
+                prev = self._search_ewma.get(pid, ms)
+                self._search_ewma[pid] = 0.8 * prev + 0.2 * ms
             if self.slowlog.should_log(ms, killed=ctx.killed):
                 t = trace or {}
                 self.slowlog.add({
@@ -1490,7 +1560,7 @@ class PSServer:
                     "metric": metric,
                     "columnar": True,
                     "keys": results.keys,
-                    "scores": np.asarray(results.scores, dtype=np.float32),
+                    "scores": np.asarray(results.scores, dtype=np.float32),  # lint: allow[host-sync] terminal result materialization for the wire codec
                 }
             else:
                 # engine fell back to the item shape (e.g. sort rode in)
@@ -1498,7 +1568,7 @@ class PSServer:
                     "metric": metric,
                     "columnar": True,
                     "keys": [[it.key for it in r.items] for r in results],
-                    "scores": np.asarray(
+                    "scores": np.asarray(  # lint: allow[host-sync] terminal result materialization for the wire codec
                         [it.score for r in results for it in r.items],
                         dtype=np.float32,
                     ),
@@ -1729,8 +1799,10 @@ class PSServer:
         # jobs, ps/backup/ps_backup_service.go:77,113 — the shard
         # manager tracks per-shard state the progress route reports)
         job = {"job_id": job_id, "partition_id": pid, "status": "dumping",
-               "files_done": 0, "files_total": None, "started": time.time(),
-               "updated": time.time(), "result": None, "error": None}
+               "files_done": 0, "files_total": None,
+               "started": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+               "updated": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+               "result": None, "error": None}
         from vearch_tpu.utils import prune_job_registry
 
         with self._backup_jobs_lock:
@@ -1744,10 +1816,11 @@ class PSServer:
         def run():
             try:
                 out = self._run_shard_backup(pid, store, body, job)
-                job.update(status="done", result=out, updated=time.time())
+                job.update(status="done", result=out,
+                           updated=time.time())  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
             except Exception as e:
                 job.update(status="error", error=f"{type(e).__name__}: {e}",
-                           updated=time.time())
+                           updated=time.time())  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
 
         threading.Thread(target=run, daemon=True,
                          name=f"backup-{job_id}").start()
@@ -1762,7 +1835,8 @@ class PSServer:
         def progress(done_files: int, total: int) -> None:
             if job is not None:
                 job.update(status="uploading", files_done=done_files,
-                           files_total=total, updated=time.time())
+                           files_total=total,
+                           updated=time.time())  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
 
         with tempfile.TemporaryDirectory() as tmp:
             eng.dump(tmp)
@@ -1812,7 +1886,7 @@ class PSServer:
                 )
             else:
                 n = store.get_tree(body["key_prefix"], stage)
-            with self._flush_locks.setdefault(pid, threading.Lock()), \
+            with self._flush_lock(pid), \
                     node._apply_lock:
                 eng.close()
                 for name in list(os.listdir(data_dir)):
@@ -1828,7 +1902,8 @@ class PSServer:
                 self._wire_engine(pid, restored)
                 with self._lock:
                     self.engines[pid] = restored
-                self._mem_dirty = True
+                with self._stats_lock:
+                    self._mem_dirty = True
                 # restored state supersedes the log: reset it at the
                 # current applied horizon (a point-in-time rewind).
                 # last_term is the term AT last_index, so the horizon
@@ -1836,8 +1911,11 @@ class PSServer:
                 horizon_term = node.wal.term_at(node.wal.last_index)
                 node.wal.reset(node.wal.last_index + 1,
                                horizon_term=horizon_term)
-                node.applied = node.wal.last_index
-                node.wal.commit_index = node.wal.last_index
+                # lock-fix note: applied is raft-lock-guarded; the old
+                # bare write raced the apply loop's applied+1 read
+                with node._lock:
+                    node.applied = node.wal.last_index
+                    node.wal.commit_index = node.wal.last_index
                 node.wal.save_meta(fsync=True)
         finally:
             shutil.rmtree(stage, ignore_errors=True)
@@ -1854,7 +1932,7 @@ class PSServer:
                 "entries": len(self.search_cache),
                 **self.search_cache.stats,
             },
-            # snapshot first: search threads insert keys lock-free
+            # snapshot under no lock: stale reads are fine for stats
             "search_ewma_ms": {
                 str(pid): round(ms, 2)
                 for pid, ms in dict(self._search_ewma).items()

@@ -28,6 +28,8 @@ from typing import Any, Callable, Mapping
 
 import numpy as np
 
+from vearch_tpu.tools import lockcheck
+
 __all__ = [
     "canonical_query_key",
     "VersionedLRUCache",
@@ -66,6 +68,7 @@ def canonical_query_key(
     return h.hexdigest()
 
 
+@lockcheck.guarded
 class VersionedLRUCache:
     """Thread-safe LRU whose entries validate against data versions.
 
@@ -90,10 +93,14 @@ class VersionedLRUCache:
     EVENTS = ("hit", "miss", "invalidated", "eviction", "bypass",
               "coalesced")
 
+    # stats is mutated in place under _lock too; declaring it catches a
+    # future rebind (e.g. a reset that swaps the dict) done lock-free
+    _guarded_by = {"_data": "_lock", "stats": "_lock"}
+
     def __init__(self, max_entries: int = 512, ttl_s: float = 0.0):
         self.max_entries = int(max_entries)
         self.ttl_s = float(ttl_s)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("querycache.lru")
         self._data: OrderedDict[str, tuple[Any, dict, float]] = (
             OrderedDict()
         )
@@ -117,7 +124,7 @@ class VersionedLRUCache:
     ) -> Any | None:
         import time as _time
 
-        t = _time.time() if now is None else now
+        t = _time.monotonic() if now is None else now
         with self._lock:
             ent = self._data.get(key)
             if ent is None:
@@ -153,7 +160,7 @@ class VersionedLRUCache:
 
         if self.max_entries <= 0:
             return
-        t = _time.time() if now is None else now
+        t = _time.monotonic() if now is None else now
         with self._lock:
             self._data[key] = (value, dict(versions or {}), t)
             self._data.move_to_end(key)
@@ -178,6 +185,7 @@ class _Flight:
         self.waiters = 0
 
 
+@lockcheck.guarded
 class SingleFlight:
     """Coalesce concurrent calls with the same key into one execution.
 
@@ -188,9 +196,11 @@ class SingleFlight:
     moment the leader finishes, so a later call recomputes.
     """
 
+    _guarded_by = {"_flights": "_lock"}
+
     def __init__(self, timeout_s: float = 30.0):
         self.timeout_s = float(timeout_s)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("querycache.singleflight")
         self._flights: dict[Any, _Flight] = {}
 
     def waiters(self, key: Any) -> int:

@@ -35,14 +35,45 @@ from typing import Any, Callable
 
 import numpy as np
 
+from vearch_tpu.cluster.metrics import internal_error
 from vearch_tpu.cluster.rpc import RpcError
 from vearch_tpu.cluster.wal import Wal
+from vearch_tpu.tools import lockcheck
 
 SNAP_CHUNK = 4 << 20  # 4 MB per snapshot chunk (reference streams 10MB)
 
 
+@lockcheck.guarded
 class RaftNode:
     """One replica of one partition's replicated log."""
+
+    # lock discipline (lint VL201 + runtime lockcheck): every
+    # term/commit/membership decision and all leader-side replication
+    # state mutates only under _lock. Methods whose callers all hold it
+    # carry a `# lint: holds[_lock]` claim, verified at runtime when
+    # VEARCH_LOCKCHECK=1.
+    _guarded_by = {
+        "_match": "_lock",
+        "_next": "_lock",
+        "_peer_commit": "_lock",
+        "_last_peer_ack": "_lock",
+        "_snap_in": "_lock",
+        "_resync_pending": "_lock",
+        "_peer_locks": "_lock",
+        "_apply_results": "_lock",
+        "applied": "_lock",
+        "is_leader": "_lock",
+        "members": "_lock",
+        "leader_hint": "_lock",
+        "_last_leader_contact": "_lock",
+        "_election_jitter": "_lock",
+        "_stopped": "_lock",
+        "snapshots_sent": "_lock",
+        "snapshots_installed": "_lock",
+        "elections_started": "_lock",
+        "elections_won": "_lock",
+        "heartbeats_acked": "_lock",
+    }
 
     def __init__(
         self,
@@ -75,10 +106,13 @@ class RaftNode:
         self.applied = 0  # set by recovery before serving
         self._apply_results: dict[int, Any] = {}
 
-        self._lock = threading.RLock()  # protects term/commit/log decisions
-        self._apply_lock = threading.Lock()  # serialises state-machine applies
-        self._propose_lock = threading.Lock()  # one in-flight proposal batch
-        self._peer_locks: dict[int, threading.Lock] = {}
+        # protects term/commit/log decisions
+        self._lock = lockcheck.make_lock("raft._lock", reentrant=True)
+        # serialises state-machine applies
+        self._apply_lock = lockcheck.make_lock("raft._apply_lock")
+        # one in-flight proposal batch
+        self._propose_lock = lockcheck.make_lock("raft._propose_lock")
+        self._peer_locks: dict[int, Any] = {}
         self._match: dict[int, int] = {}  # peer -> highest replicated index
         self._next: dict[int, int] = {}  # peer -> next index to send
         self._commit_cv = threading.Condition(self._lock)
@@ -115,8 +149,10 @@ class RaftNode:
         # only entries of the current term by counting (a no-op entry
         # appended on election carries prior-term entries).
         self.election_timeout = election_timeout
-        self._born = time.time()  # baseline for ack ages before first ack
-        self._last_leader_contact = time.time()
+        # monotonic clock: ack ages and election quiet-times are
+        # durations, which an NTP step must not bend
+        self._born = time.monotonic()  # baseline for ack ages
+        self._last_leader_contact = time.monotonic()
         self.leader_hint: int | None = node_id if is_leader else None
         import random
 
@@ -140,8 +176,10 @@ class RaftNode:
             return
         try:
             self._observer(event, info)
-        except Exception:
-            pass  # observability must never fail the protocol
+        except Exception as e:
+            # observability must never fail the protocol — but a broken
+            # observer must not fail silently either
+            internal_error("raft.observer", e)
 
     def replication_lag(self) -> dict[int, int]:
         """Per-peer entries behind the leader's log end (leader view).
@@ -158,7 +196,7 @@ class RaftNode:
         """Seconds since this node last saw proof of a live replication
         channel: for a leader, the OLDEST peer ack (worst case across
         followers); for a follower, the last leader contact."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             if self.is_leader:
                 peers = [m for m in self.members if m != self.node_id]
@@ -171,7 +209,7 @@ class RaftNode:
 
     def state(self) -> dict:
         with self._lock:
-            now = time.time()
+            now = time.monotonic()
             last = self.wal.last_index
             peers = {
                 str(p): {
@@ -217,11 +255,15 @@ class RaftNode:
         `apply_ms` / `total_ms` + `_phase_spans` rows) — the write-side
         analogue of the engine's trace dict, replayed by the PS as child
         spans under ps.upsert / ps.delete."""
-        t_enter = time.time()
+        t_enter = time.monotonic()
+        # one wall reading anchors the span epochs; every phase window
+        # is measured monotonically and offset from it (an NTP step
+        # mid-proposal must not corrupt the durations)
+        wall0 = time.time() - t_enter  # lint: allow[wall-clock] span epoch anchor, correlates with collector time
         with self._propose_lock:
             # serialized proposals queue on _propose_lock: the wait here
             # is the write-side analogue of the search gate wait
-            t_lock = time.time()
+            t_lock = time.monotonic()
             with self._lock:
                 if self._stopped:
                     raise RpcError(503, f"partition {self.pid}: stopped")
@@ -233,9 +275,9 @@ class RaftNode:
                     {"index": start + i, "term": term, "op": op}
                     for i, op in enumerate(ops)
                 ]
-                t_wal = time.time()
+                t_wal = time.monotonic()
                 self.wal.append(entries, fsync=True)
-                t_append = time.time()
+                t_append = time.monotonic()
                 target = entries[-1]["index"]
             self._replicate_and_wait(target)
             with self._lock:
@@ -245,7 +287,7 @@ class RaftNode:
                         f"partition {self.pid}: no quorum for index "
                         f"{target} within {self.quorum_timeout}s",
                     )
-            t_commit = time.time()
+            t_commit = time.monotonic()
             # append -> quorum-commit wall time (the replication RTT the
             # client write waited for)
             self._observe("commit", {
@@ -253,7 +295,7 @@ class RaftNode:
                 "entries": len(entries),
             })
             self._apply_to_commit()
-            t_apply = time.time()
+            t_apply = time.monotonic()
             # push the advanced commit index to followers synchronously
             # so they apply before the client sees the ack — follower
             # reads (load_balance random/not_leader) then serve the
@@ -263,13 +305,16 @@ class RaftNode:
             self._notify_commit()
             if timing is not None:
                 spans = []
-                spans.append(["raft.propose_wait", int(t_enter * 1e6),
+                spans.append(["raft.propose_wait",
+                              int((wall0 + t_enter) * 1e6),
                               int((t_lock - t_enter) * 1e6)])
-                spans.append(["wal.append", int(t_wal * 1e6),
+                spans.append(["wal.append", int((wall0 + t_wal) * 1e6),
                               int((t_append - t_wal) * 1e6)])
-                spans.append(["raft.commit_wait", int(t_append * 1e6),
+                spans.append(["raft.commit_wait",
+                              int((wall0 + t_append) * 1e6),
                               int((t_commit - t_append) * 1e6)])
-                spans.append(["engine.apply", int(t_commit * 1e6),
+                spans.append(["engine.apply",
+                              int((wall0 + t_commit) * 1e6),
                               int((t_apply - t_commit) * 1e6)])
                 timing["propose_wait_ms"] = round(
                     (t_lock - t_enter) * 1e3, 3)
@@ -279,7 +324,7 @@ class RaftNode:
                     (t_commit - t_append) * 1e3, 3)
                 timing["apply_ms"] = round((t_apply - t_commit) * 1e3, 3)
                 timing["total_ms"] = round(
-                    (time.time() - t_enter) * 1e3, 3)
+                    (time.monotonic() - t_enter) * 1e3, 3)
                 timing["entries"] = len(entries)
                 timing["_phase_spans"] = spans
             with self._lock:
@@ -292,12 +337,15 @@ class RaftNode:
             return
         for p in peers:
             t = threading.Thread(
-                target=self._sync_peer, args=(p,), daemon=True
+                target=self._sync_peer, args=(p,), daemon=True,
+                name=f"raft-repl-p{self.pid}-{p}",
             )
             t.start()
-        deadline = time.time() + self.quorum_timeout
+        # monotonic deadline: an NTP step mid-wait must not stretch or
+        # collapse the quorum window (lock-fix note: was wall-clock)
+        deadline = time.monotonic() + self.quorum_timeout
         with self._commit_cv:
-            while self.commit < target and time.time() < deadline:
+            while self.commit < target and time.monotonic() < deadline:
                 self._commit_cv.wait(timeout=0.05)
 
     def _sync_peer(self, peer: int, blocking: bool = False) -> None:
@@ -313,19 +361,30 @@ class RaftNode:
         proposal. Now a contended request parks in _resync_pending and
         the holder re-probes before releasing, so a requested sync is
         never lost."""
-        lock = self._peer_locks.setdefault(peer, threading.Lock())
+        # lock-fix note: _peer_locks was populated via bare setdefault
+        # from concurrent sync threads — now created under _lock (and
+        # through make_lock so lockcheck sees the per-peer ordering)
+        with self._lock:
+            lock = self._peer_locks.setdefault(
+                peer, lockcheck.make_lock(f"raft.peer{peer}"))
         if not lock.acquire(blocking=blocking):
-            self._resync_pending.add(peer)
+            # lock-fix note: _resync_pending is a plain set; its
+            # add/discard/probe now all run under _lock (peer_lock ->
+            # _lock is the established order, so no inversion)
+            with self._lock:
+                self._resync_pending.add(peer)
             # the holder may have checked the flag just before we set
             # it; retry the handoff if the lock is now free
             if not lock.acquire(blocking=False):
                 return
         try:
             while True:
-                self._resync_pending.discard(peer)
+                with self._lock:
+                    self._resync_pending.discard(peer)
                 self._sync_peer_locked(peer)
-                if peer not in self._resync_pending or self._stopped:
-                    return
+                with self._lock:
+                    if peer not in self._resync_pending or self._stopped:
+                        return
         finally:
             lock.release()
 
@@ -333,7 +392,8 @@ class RaftNode:
         peers = [m for m in self.members if m != self.node_id]
         threads = [
             threading.Thread(target=self._sync_peer, args=(p, True),
-                             daemon=True)
+                             daemon=True,
+                             name=f"raft-commit-p{self.pid}-{p}")
             for p in peers
         ]
         for t in threads:
@@ -404,7 +464,7 @@ class RaftNode:
                         self._match.get(peer, 0), sent_last
                     )
                     self._next[peer] = sent_last + 1
-                    self._last_peer_ack[peer] = time.time()
+                    self._last_peer_ack[peer] = time.monotonic()
                     self.heartbeats_acked += 1
                     # the follower adopted min(commit we sent, its log
                     # end) — remember it so the heartbeat keeps probing
@@ -488,7 +548,7 @@ class RaftNode:
         with self._lock:
             self._match[peer] = max(self._match.get(peer, 0), peer_last)
             self._next[peer] = peer_last + 1
-            self._last_peer_ack[peer] = time.time()
+            self._last_peer_ack[peer] = time.monotonic()
             self.snapshots_sent += 1
             self._advance_commit()
         self._observe("snapshot_sent", {
@@ -505,7 +565,8 @@ class RaftNode:
             peers = [m for m in self.members if m != self.node_id]
         for p in peers:
             threading.Thread(
-                target=self._sync_peer, args=(p,), daemon=True
+                target=self._sync_peer, args=(p,), daemon=True,
+                name=f"raft-tick-p{self.pid}-{p}",
             ).start()
 
     # -- apply ---------------------------------------------------------------
@@ -523,10 +584,10 @@ class RaftNode:
                     e = self.wal.get(nxt)
                 if e is None:
                     break  # compacted (snapshot already covers it)
-                t_apply = time.time()
+                t_apply = time.monotonic()
                 result = self.apply_fn(e["op"])
                 self._observe("apply", {
-                    "seconds": time.time() - t_apply, "index": nxt,
+                    "seconds": time.monotonic() - t_apply, "index": nxt,
                 })
                 out[nxt] = result
                 with self._lock:
@@ -556,7 +617,7 @@ class RaftNode:
                         "last_index": self.wal.last_index}
             if term > self.term:
                 self._step_down(term)
-            self._last_leader_contact = time.time()
+            self._last_leader_contact = time.monotonic()
             self.leader_hint = int(body.get("leader", -1))
             prev_i = int(body["prev_index"])
             prev_t = int(body["prev_term"])
@@ -628,7 +689,7 @@ class RaftNode:
                 # guard its timer would fire forever, deposing the real
                 # leader by term inflation every timeout
                 return
-            quiet = time.time() - self._last_leader_contact
+            quiet = time.monotonic() - self._last_leader_contact
             if quiet < self.election_timeout * self._election_jitter:
                 return
             # campaign: bump term, vote for self, reset the clock with a
@@ -640,7 +701,7 @@ class RaftNode:
             term = self.wal.term
             self.wal.voted_for = self.node_id
             self.wal.save_meta(fsync=True)
-            self._last_leader_contact = time.time()
+            self._last_leader_contact = time.monotonic()
             self._election_jitter = random.uniform(0.8, 1.6)
             last_index, last_term = self.wal.last_index, self.wal.last_term
             peers = [m for m in self.members if m != self.node_id]
@@ -708,7 +769,7 @@ class RaftNode:
                 self.wal.voted_for = candidate
                 self.wal.save_meta(fsync=True)
                 # granting a vote resets our own election clock
-                self._last_leader_contact = time.time()
+                self._last_leader_contact = time.monotonic()
                 return {"granted": True, "term": self.term}
             return {"granted": False, "term": self.term}
 
@@ -721,7 +782,7 @@ class RaftNode:
                 self._step_down(term)
             return self.state()
 
-    def _step_down(self, term: int) -> None:
+    def _step_down(self, term: int) -> None:  # lint: holds[_lock]
         if self.is_leader:
             self._observe("step_down", {"term": term})
         self.is_leader = False
@@ -787,8 +848,9 @@ class RaftNode:
         sid = body["sid"]
         with self._lock:
             # drop abandoned streams (leader died mid-transfer): the
-            # staging buffers are snapshot-sized, they must not pile up
-            now = time.time()
+            # staging buffers are snapshot-sized, they must not pile up.
+            # monotonic: a clock step must not mass-expire live streams
+            now = time.monotonic()
             for old_sid in [
                 s for s, st in self._snap_in.items()
                 if now - st["ts"] > 120.0
@@ -858,5 +920,8 @@ class RaftNode:
         self._apply_to_commit()
 
     def close(self) -> None:
-        self._stopped = True
+        # lock-fix note: _stopped was flipped without _lock; sync
+        # threads read it under _lock to decide whether to keep looping
+        with self._lock:
+            self._stopped = True
         self.wal.close()

@@ -255,7 +255,7 @@ class RouterServer:
                 self._server_cache = (0.0, {})
 
     def _h_router_stats(self, _body, _parts) -> dict:
-        now = time.time()
+        now = time.monotonic()
         with self._cache_lock:
             return {
                 "watch_rev": self._watch_rev,
@@ -311,7 +311,7 @@ class RouterServer:
 
     def _space(self, db: str, name: str) -> Space:
         key = f"{db}/{name}"
-        now = time.time()
+        now = time.monotonic()
         with self._cache_lock:
             hit = self._space_cache.get(key)
             if hit and now - hit[0] < self.space_cache_ttl:
@@ -345,7 +345,7 @@ class RouterServer:
         return space
 
     def _servers(self) -> dict[int, Server]:
-        now = time.time()
+        now = time.monotonic()
         with self._cache_lock:
             ts, cache = self._server_cache
             if now - ts < self.space_cache_ttl and cache:
@@ -371,7 +371,7 @@ class RouterServer:
         import random
 
         servers = self._servers()
-        now = time.time()
+        now = time.monotonic()
         part = next(p for p in space.partitions if p.id == partition_id)
         leader = part.leader if part.leader >= 0 else part.replicas[0]
         candidates = [r for r in part.replicas if r in servers]
@@ -439,7 +439,7 @@ class RouterServer:
                     # unreachable: penalise so read balancing routes
                     # around it instead of rediscovering per request
                     with self._cache_lock:
-                        self._faulty[node] = time.time() + self.faulty_ttl
+                        self._faulty[node] = time.monotonic() + self.faulty_ttl
                 if e.code not in (-1, 421, 503):
                     raise
                 last = e
@@ -454,7 +454,7 @@ class RouterServer:
 
         user, password = parse_basic_auth(headers)
         key = (user, password)
-        now = time.time()
+        now = time.monotonic()
         record = None
         with self._cache_lock:
             hit = self._auth_cache.get(key)
@@ -656,7 +656,7 @@ class RouterServer:
         )
         with root:
             def send(pid: int, docs: list[dict]):
-                t0 = time.time()
+                t0 = time.monotonic()
                 if root.ctx() is not None:
                     span = self.tracer.span(
                         "router.scatter", ctx=root.ctx(),
@@ -675,7 +675,7 @@ class RouterServer:
                 # read-your-writes search through this router miss the
                 # cache instead of serving pre-write results
                 self._note_apply_version(pid, r.get("apply_version"))
-                r["_rpc_ms"] = round((time.time() - t0) * 1e3, 3)
+                r["_rpc_ms"] = round((time.monotonic() - t0) * 1e3, 3)
                 return pid, r
 
             futures = [
@@ -683,7 +683,7 @@ class RouterServer:
                 for pid, docs in by_partition.items()
             ]
             results = [f.result() for f in futures]
-            t_merge = time.time()
+            t_merge = time.monotonic()
             keys: list[str] = []
             for _, r in results:
                 keys.extend(r["keys"])
@@ -699,7 +699,7 @@ class RouterServer:
                                    **(r.get("profile") or {})}
                         for pid, r in results
                     },
-                    "merge_ms": round((time.time() - t_merge) * 1e3, 3),
+                    "merge_ms": round((time.monotonic() - t_merge) * 1e3, 3),
                     "partition_count": len(results),
                 }
             return out
@@ -817,7 +817,7 @@ class RouterServer:
         return 0, k
 
     def _h_search(self, body: dict, _parts) -> dict:
-        t0 = time.time()
+        t0 = time.monotonic()
         out: dict | None = None
         killed = False
         try:
@@ -829,7 +829,7 @@ class RouterServer:
             killed = e.code == ERR_REQUEST_KILLED
             raise
         finally:
-            ms = (time.time() - t0) * 1e3
+            ms = (time.monotonic() - t0) * 1e3
             if self.slowlog.should_log(ms, killed=killed):
                 entry = {
                     "op": "search",
@@ -1059,7 +1059,7 @@ class RouterServer:
         from vearch_tpu.cluster.tracing import NULL_SPAN
 
         def timed(pid):
-            t0 = _time.time()
+            t0 = _time.monotonic()
             if root.ctx() is not None:
                 span = self.tracer.span(
                     "router.scatter", ctx=root.ctx(),
@@ -1075,7 +1075,7 @@ class RouterServer:
             # every partial carries the partition's apply version —
             # feed the router's validity map even on plain searches
             self._note_apply_version(pid, r.get("apply_version"))
-            r["_rpc_ms"] = round((_time.time() - t0) * 1e3, 3)
+            r["_rpc_ms"] = round((_time.monotonic() - t0) * 1e3, 3)
             return pid, r
 
         futures = [
@@ -1083,7 +1083,7 @@ class RouterServer:
         ]
         results = [f.result() for f in futures]
         partials = [r for _, r in results]
-        t_merge = _time.time()
+        t_merge = _time.monotonic()
         if sort_specs:
             merged = self._merge_search_sorted(
                 partials, sort_specs, k, start, size)
@@ -1109,7 +1109,7 @@ class RouterServer:
             }
         else:
             out = {"documents": merged}
-        return out, results, round((_time.time() - t_merge) * 1e3, 3)
+        return out, results, round((_time.monotonic() - t_merge) * 1e3, 3)
 
     def _merge_search(
         self, partials: list[dict], k: int

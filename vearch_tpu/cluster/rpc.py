@@ -198,8 +198,8 @@ def _sample_profile(seconds: float, interval: float = 0.01) -> str:
     frame_counts: collections.Counter = collections.Counter()
     stack_counts: collections.Counter = collections.Counter()
     samples = 0
-    deadline = time.time() + seconds
-    while time.time() < deadline:
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue
@@ -253,6 +253,11 @@ class JsonRpcServer:
         self.middleware: Callable | None = None
         self.metrics = Registry()
         register_process_gauges(self.metrics)
+        from vearch_tpu.cluster.metrics import INTERNAL_ERRORS
+
+        # process-wide: the swallowed-exception counter raft/WAL feed
+        # has no server of its own; every role's /metrics exposes it
+        self.metrics.attach(INTERNAL_ERRORS)
         self._m_requests = self.metrics.counter(
             "vearch_request_total", "RPC requests",
             ("method", "path", "code"),
@@ -386,7 +391,7 @@ class JsonRpcServer:
                     self.end_headers()
                     self.wfile.write(data)
                     return
-                t0 = time.time()
+                t0 = time.monotonic()
                 code = 0
                 prefix = self.path.split("?")[0]
                 _request_ctx.auth = self.headers.get("Authorization")
@@ -448,7 +453,7 @@ class JsonRpcServer:
                     )
                 finally:
                     _request_ctx.auth = None
-                    dt = time.time() - t0
+                    dt = time.monotonic() - t0
                     # access log at debug (reference: request logs are
                     # debug-gated; IsDebugEnabled avoids the format cost)
                     if log.is_debug_enabled():
@@ -525,7 +530,8 @@ class JsonRpcServer:
 
     def start(self) -> None:
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"rpc-httpd-{self.addr}",
         )
         self._thread.start()
 

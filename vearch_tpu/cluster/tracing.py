@@ -26,6 +26,8 @@ import json
 import random
 import threading
 import time
+
+from vearch_tpu.utils import mono_us
 import uuid
 from collections import deque
 from typing import Any
@@ -34,7 +36,7 @@ from typing import Any
 class Span:
     __slots__ = (
         "tracer", "trace_id", "span_id", "parent_id", "name", "service",
-        "start_us", "dur_us", "tags", "status",
+        "start_us", "dur_us", "tags", "status", "_t0",
     )
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
@@ -45,7 +47,8 @@ class Span:
         self.parent_id = parent_id
         self.name = name
         self.service = tracer.service
-        self.start_us = int(time.time() * 1e6)
+        self._t0 = time.monotonic()
+        self.start_us = mono_us(self._t0)
         self.dur_us = 0
         self.tags: dict[str, Any] = dict(tags or {})
         self.status = "ok"
@@ -63,7 +66,7 @@ class Span:
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc is not None:
             self.status = f"error: {type(exc).__name__}"
-        self.dur_us = int(time.time() * 1e6) - self.start_us
+        self.dur_us = int((time.monotonic() - self._t0) * 1e6)
         self.tracer._finish(self)
 
     def to_dict(self) -> dict:
@@ -295,7 +298,7 @@ class SlowLog:
 
     def add(self, entry: dict) -> None:
         e = dict(entry)
-        e.setdefault("ts", time.time())
+        e.setdefault("ts", time.time())  # lint: allow[wall-clock] operator-facing slowlog stamp, display-only
         with self._lock:
             self._entries.append(e)
 

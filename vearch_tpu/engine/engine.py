@@ -97,11 +97,12 @@ class RequestContext:
     api_data/request_context.h; the PS slow-request killer and the
     /ps/kill admin both flip it).
 
-    `deadline` (absolute epoch seconds) arms check() itself: a request
-    past its deadline self-kills at the next phase boundary — between
-    device dispatches, never mid-kernel — without waiting on the PS
-    killer loop's tick. `reason_code` is the bounded label the PS
-    exports on vearch_requests_killed_total."""
+    `deadline` (absolute `time.monotonic()` seconds — NOT wall epoch:
+    an NTP step must not expire or immortalize a live request) arms
+    check() itself: a request past its deadline self-kills at the next
+    phase boundary — between device dispatches, never mid-kernel —
+    without waiting on the PS killer loop's tick. `reason_code` is the
+    bounded label the PS exports on vearch_requests_killed_total."""
 
     def __init__(self, request_id: str = "",
                  deadline: float | None = None):
@@ -118,7 +119,7 @@ class RequestContext:
 
     def check(self) -> None:
         if (not self.killed and self.deadline is not None
-                and time.time() > self.deadline):
+                and time.monotonic() > self.deadline):
             self.kill("deadline exceeded", code="deadline")
         if self.killed:
             raise RequestKilled(self.reason or "request killed")
@@ -512,7 +513,8 @@ class Engine:
         if not needs or self.status != IndexStatus.UNINDEXED:
             return
         self.status = IndexStatus.TRAINING
-        t = threading.Thread(target=self.build_index, daemon=True)
+        t = threading.Thread(target=self.build_index, daemon=True,
+                             name="engine-build")
         t.start()
         self._build_thread = t
 
@@ -546,7 +548,8 @@ class Engine:
                         except Exception as e:
                             self.last_build_error = e
 
-        self._refresh_thread = threading.Thread(target=loop, daemon=True)
+        self._refresh_thread = threading.Thread(target=loop, daemon=True,
+                                                name="engine-refresh")
         self._refresh_thread.start()
 
     def close(self) -> None:
@@ -771,7 +774,10 @@ class Engine:
         docs_total) and terminal status while the build runs, with the
         real wall window of each phase kept as `_phase_spans` rows for
         the PS to replay into /debug/traces."""
-        t_start = time.time()
+        t_start = time.monotonic()
+        # one wall anchor for span epochs + operator-facing timestamps;
+        # phase durations are measured monotonically and offset from it
+        wall0 = time.time() - t_start  # lint: allow[wall-clock] span epoch anchor, correlates with collector time
         targets = [
             (name, idx) for name, idx in self.indexes.items()
             if field_name is None or name == field_name
@@ -780,55 +786,58 @@ class Engine:
             "op": op, "status": "running", "phase": "train",
             "docs_total": sum(
                 self.vector_stores[n].count for n, _ in targets),
-            "docs_done": 0, "started": t_start, "updated": t_start,
+            "docs_done": 0,
+            "started": wall0 + t_start, "updated": wall0 + t_start,
             "phases_ms": {}, "error": None, "_phase_spans": [],
         }
         self.build_job = job
         phases = job["_phase_spans"]
 
         def mark(phase: str, t0: float, t1: float) -> None:
-            phases.append((f"build.{phase}", int(t0 * 1e6),
+            phases.append((f"build.{phase}", int((wall0 + t0) * 1e6),
                            int((t1 - t0) * 1e6)))
             job["phases_ms"][phase] = round(
                 job["phases_ms"].get(phase, 0.0) + (t1 - t0) * 1e3, 3)
             job["phase"] = phase
-            job["updated"] = t1
+            job["updated"] = wall0 + t1
 
         self.status = IndexStatus.TRAINING
         try:
             for name, index in targets:
                 store = self.vector_stores[name]
                 if index.needs_training and not index.trained:
-                    t0 = time.time()
+                    t0 = time.monotonic()
                     index.train(store.host_view())
-                    mark("train", t0, time.time())
-                t0 = time.time()
+                    mark("train", t0, time.monotonic())
+                t0 = time.monotonic()
                 index.absorb(store.count)
-                mark("assign", t0, time.time())
+                mark("assign", t0, time.monotonic())
                 job["docs_done"] += store.count
         except Exception as e:
             # a failed (possibly background) build must not wedge the
             # engine in TRAINING: record, reset, keep serving brute-force
             self.last_build_error = e
             self.status = IndexStatus.UNINDEXED
+            now = time.monotonic()
             job.update(status="error",
                        error=f"{type(e).__name__}: {e}",
-                       duration_seconds=round(time.time() - t_start, 3),
-                       updated=time.time())
+                       duration_seconds=round(now - t_start, 3),
+                       updated=wall0 + now)
             self._notify_build(job)
             raise
-        t0 = time.time()
+        t0 = time.monotonic()
         self.status = IndexStatus.INDEXED
-        mark("publish", t0, time.time())
+        mark("publish", t0, time.monotonic())
         # pre-trace the serving programs for the configured batch buckets
         # now, at publish time, so the first real query never pays the
         # compile stall (no-op unless "warmup_batches" is configured)
-        t0 = time.time()
+        t0 = time.monotonic()
         self.warmup(field_name=field_name)
-        mark("warmup", t0, time.time())
+        mark("warmup", t0, time.monotonic())
+        now = time.monotonic()
         job.update(status="done", phase="done",
-                   duration_seconds=round(time.time() - t_start, 3),
-                   updated=time.time())
+                   duration_seconds=round(now - t_start, 3),
+                   updated=wall0 + now)
         self._notify_build(job)
 
     def _notify_build(self, job: dict) -> None:
@@ -1000,7 +1009,7 @@ class Engine:
 
             capture = _ivf_ops.begin_capture()
         try:
-            t_start = _time.time()
+            t_start = _time.monotonic()
             n = self.table.doc_count
             if req.filters is not None:
                 valid = self._filtered_mask(req.filters, n)
@@ -1010,7 +1019,7 @@ class Engine:
                 # [n]-bool H2D upload
                 valid = self._device_alive_mask(n)
             if tracing:
-                t_filter = _time.time()
+                t_filter = _time.monotonic()
                 req.trace["filter_ms"] = round((t_filter - t_start) * 1e3, 3)
                 phases.append(("engine.filter", t_start, t_filter))
 
@@ -1027,9 +1036,9 @@ class Engine:
             for name, queries in req.vectors.items():
                 if req.ctx is not None:
                     req.ctx.check()
-                t_field = _time.time()
+                t_field = _time.monotonic()
                 index = self.indexes[name]
-                queries = np.asarray(queries)
+                queries = np.asarray(queries)  # lint: allow[host-sync] host-side input normalization, queries arrive as lists/host arrays
                 if queries.ndim == 1:
                     queries = queries[None, :]
                 queries = index.decode_input(
@@ -1062,7 +1071,7 @@ class Engine:
                     # close the open dispatch window: device work for
                     # this field is done (device_get already blocked)
                     _ivf_ops.capture_mark()
-                    t_done = _time.time()
+                    t_done = _time.monotonic()
                     req.trace[f"search_{name}_ms"] = round(
                         (t_done - t_field) * 1e3, 3
                     )
@@ -1070,12 +1079,12 @@ class Engine:
 
             if req.ctx is not None:
                 req.ctx.check()
-            t_merge = _time.time()
+            t_merge = _time.monotonic()
             merged = self._merge_fields(per_field, queries_by_field, req)
-            t_shape = _time.time()
+            t_shape = _time.monotonic()
             results = self._shape_results(merged, req)
             if tracing:
-                t_end = _time.time()
+                t_end = _time.monotonic()
                 req.trace["merge_ms"] = round((t_shape - t_merge) * 1e3, 3)
                 req.trace["shape_ms"] = round((t_end - t_shape) * 1e3, 3)
                 phases.append(("engine.merge", t_merge, t_shape))
@@ -1121,14 +1130,17 @@ class Engine:
             self._predicted_scan_bytes(name) for name in req.vectors
         )
         # extend, don't replace: the microbatcher may have noted its
-        # queue wait on this trace before the search ran
+        # queue wait on this trace before the search ran. Phase/capture
+        # stamps are monotonic; mono_us anchors them to the epoch.
+        from vearch_tpu.utils import mono_us
+
         spans = list(trace.get("_phase_spans") or [])
         spans += [
-            [name, int(t0 * 1e6), int((t1 - t0) * 1e6)]
+            [name, mono_us(t0), int((t1 - t0) * 1e6)]
             for name, t0, t1 in phases
         ]
         spans.extend(
-            [f"kernel.{tag}", int(t0 * 1e6), int((t1 - t0) * 1e6)]
+            [f"kernel.{tag}", mono_us(t0), int((t1 - t0) * 1e6)]
             for tag, t0, t1 in capture.events
             if t1 is not None
         )

@@ -52,11 +52,13 @@ class _Pending:
         # _run_group to report how long this request sat behind the
         # in-flight device dispatch (trace key queue_ms + a
         # microbatch.queue phase span)
-        self.t_enqueue = time.time()
+        self.t_enqueue = time.monotonic()
 
 
 def _note_queue_wait(p: "_Pending", t_dequeue: float) -> None:
     """Record the microbatch queue wait on a traced pending request."""
+    from vearch_tpu.utils import mono_us
+
     if p.req.trace is None:
         return
     wait_ms = max(0.0, (t_dequeue - p.t_enqueue) * 1e3)
@@ -64,7 +66,7 @@ def _note_queue_wait(p: "_Pending", t_dequeue: float) -> None:
     # copy-on-write: the group trace dict (and its _phase_spans list) is
     # shared by every pending in the group — never mutate the shared list
     spans = list(p.req.trace.get("_phase_spans") or [])
-    spans.append(["microbatch.queue", int(p.t_enqueue * 1e6),
+    spans.append(["microbatch.queue", mono_us(p.t_enqueue),
                   int(wait_ms * 1e3)])
     p.req.trace["_phase_spans"] = spans
 
@@ -180,7 +182,7 @@ class MicroBatcher:
         return order
 
     def _run_group(self, group: list[_Pending]) -> None:
-        t_dequeue = time.time()
+        t_dequeue = time.monotonic()
         if len(group) == 1:
             p = group[0]
             try:

@@ -71,11 +71,13 @@ class DispatchCapture:
     __slots__ = ("events",)
 
     def __init__(self) -> None:
-        # [tag, start_epoch_s, end_epoch_s | None]
+        # [tag, start_monotonic_s, end_monotonic_s | None] — consumers
+        # (engine._record_dispatch_trace) anchor to the epoch via
+        # utils.mono_us when emitting spans
         self.events: list[list] = []
 
     def note(self, tag: str) -> None:
-        now = time.time()
+        now = time.monotonic()
         if self.events and self.events[-1][2] is None:
             self.events[-1][2] = now
         self.events.append([tag, now, None])
@@ -84,7 +86,7 @@ class DispatchCapture:
         """Close the open dispatch window (call when device work for the
         current index.search has completed)."""
         if self.events and self.events[-1][2] is None:
-            self.events[-1][2] = time.time()
+            self.events[-1][2] = time.monotonic()
 
     @property
     def tags(self) -> list[str]:

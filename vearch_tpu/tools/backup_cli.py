@@ -84,10 +84,10 @@ def main(argv: list[str] | None = None) -> int:
             job_id = out["job_id"]
             import time as _time
 
-            poll_deadline = _time.time() + 3600.0
+            poll_deadline = _time.monotonic() + 3600.0
             misses = 0
             while True:
-                if _time.time() > poll_deadline:
+                if _time.monotonic() > poll_deadline:
                     print("\ngave up polling after 1h; job may still be "
                           f"running: GET /backup/jobs/{job_id}",
                           file=sys.stderr)

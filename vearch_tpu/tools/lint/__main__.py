@@ -1,0 +1,69 @@
+"""CLI: python -m vearch_tpu.tools.lint [paths...]
+
+Exit 0 when every finding is suppressed with a reason (inline pragma
+or allowlist entry); exit 1 otherwise. `--show-allowed` prints the
+suppressed findings too, so the waiver inventory stays reviewable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from vearch_tpu.tools.lint import (
+    Allowlist, RULES, default_allowlist_path, run_paths,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="vearch-lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the vearch_tpu "
+                         "package)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist file (default: the checked-in "
+                         "tools/lint/allowlist.txt)")
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="ignore the allowlist (show everything)")
+    ap.add_argument("--show-allowed", action="store_true",
+                    help="also print suppressed findings with reasons")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    # importing run_paths' rule modules happens inside run_paths; for
+    # --list-rules force it eagerly
+    from vearch_tpu.tools.lint import (  # noqa: F401
+        rules_dispatch, rules_errors, rules_locks, rules_obs,
+    )
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id}  allow[{r.tag}]  {r.doc}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        import vearch_tpu
+
+        paths = [os.path.dirname(os.path.abspath(vearch_tpu.__file__))]
+
+    allowlist = None
+    if not args.no_allowlist:
+        allowlist = Allowlist(args.allowlist or default_allowlist_path())
+
+    findings = run_paths(paths, allowlist=allowlist)
+    hard = [f for f in findings if not f.suppressed]
+    soft = [f for f in findings if f.suppressed]
+    for f in hard:
+        print(f.render())
+    if args.show_allowed:
+        for f in soft:
+            print(f.render())
+    print(f"vearch-lint: {len(hard)} finding(s), "
+          f"{len(soft)} allowed with reasons")
+    return 1 if hard else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

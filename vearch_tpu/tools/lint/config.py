@@ -1,0 +1,70 @@
+"""Project policy knobs for vearch-lint.
+
+Everything path-shaped is a POSIX path *suffix* matched against the
+scanned file path, so the linter works from any working directory.
+"""
+
+from __future__ import annotations
+
+# -- VL101 dispatch hygiene ---------------------------------------------------
+# Packages allowed to create device dispatches (jax.jit / pallas_call /
+# pmap / shard_map). Everything else — the cluster plane above all —
+# must call into these layers instead of tracing its own programs, or
+# the perf model's DOCUMENTED_DISPATCHES stops being the whole story.
+DISPATCH_PACKAGES = (
+    "vearch_tpu/ops/",
+    "vearch_tpu/engine/",
+)
+
+# Names whose call or decorator use counts as creating a dispatchable
+# program. Attribute form (jax.jit) and bare imported form (jit) both.
+DISPATCH_CONSTRUCTS = {
+    "jit", "pmap", "pallas_call", "shard_map", "xla_computation",
+}
+
+# -- VL102 host-device sync points in serving paths ---------------------------
+# (path suffix, function qualname) pairs marking the hot serving path.
+# Inside these functions a host sync (block_until_ready / device_get /
+# .item() / np.asarray materialisation) stalls the request on device
+# completion and must carry an inline allow[host-sync] justification.
+SERVING_PATH_FUNCTIONS = {
+    ("vearch_tpu/engine/engine.py", "Engine.search"),
+    ("vearch_tpu/engine/engine.py", "Engine._search_direct"),
+    ("vearch_tpu/cluster/ps.py", "PSServer._h_search"),
+    ("vearch_tpu/cluster/ps.py", "PSServer._do_search"),
+    ("vearch_tpu/cluster/router.py", "Router._h_search"),
+    ("vearch_tpu/cluster/router.py", "Router._search_impl"),
+    ("vearch_tpu/cluster/router.py", "Router._search_scatter"),
+}
+
+HOST_SYNC_METHODS = {"block_until_ready", "item"}
+HOST_SYNC_CALLS = {"device_get", "asarray", "array"}
+
+# -- VL203 wall-clock discipline ---------------------------------------------
+# time.time() is banned for anything measured or compared (latency,
+# deadlines, TTLs): wall clocks step under NTP and the measurement
+# silently corrupts. time.monotonic() is the default; genuinely
+# wall-anchored stamps (span epochs, persisted create times) carry an
+# inline allow[wall-clock] with the reason.
+
+# -- VL302 swallowed exceptions ----------------------------------------------
+# Modules whose apply/commit paths must never swallow an exception
+# silently: a broad handler there needs a raise, a log call, or an
+# internal_error() count before it may continue.
+CRITICAL_ERROR_MODULES = (
+    "vearch_tpu/cluster/raft.py",
+    "vearch_tpu/cluster/wal.py",
+)
+
+LOG_CALL_NAMES = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+}
+ERROR_COUNT_CALLS = {"internal_error", "inc"}
+
+# -- VL201 lock discipline ----------------------------------------------------
+# Methods treated as mutations when called on a guarded attribute.
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popitem", "clear", "update", "setdefault", "move_to_end",
+    "appendleft", "popleft",
+}

@@ -1,0 +1,134 @@
+"""Dispatch-hygiene rules.
+
+VL101 — dispatch-creating constructs (`jax.jit`, `pallas_call`,
+`pmap`, `shard_map`) may only appear in the device layers
+(`ops/`, `engine/`). A jit hidden in the cluster plane creates device
+programs the perf model never counted — the zero-retrace and
+dispatch-count CI gates (docs/PERF.md) only hold if every program is
+born where the model can see it.
+
+VL102 — host-device sync points (`block_until_ready`, `device_get`,
+`.item()`, `np.asarray` / `np.array` materialisation) inside the
+configured serving-path functions. Each one stalls the request thread
+on device completion; the intended ones (terminal result
+materialisation) carry an inline `allow[host-sync]` reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from vearch_tpu.tools.lint import config
+from vearch_tpu.tools.lint.core import FileContext, Finding, Rule, register
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """Render a Name/Attribute chain as 'a.b.c'; None for anything else."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _qualname(ctx: FileContext, func: ast.AST) -> str:
+    names = [func.name]
+    for anc in ctx.ancestors(func):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(anc.name)
+    return ".".join(reversed(names))
+
+
+def _check_dispatch(ctx: FileContext):
+    path = _norm(ctx.path)
+    if any(pkg in path for pkg in config.DISPATCH_PACKAGES):
+        return
+    for node in ast.walk(ctx.tree):
+        name = None
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dname = _dotted(target)
+                if dname and dname.split(".")[-1] in \
+                        config.DISPATCH_CONSTRUCTS:
+                    name = dname
+                    node = dec  # report the decorator line
+                    break
+        if not name:
+            continue
+        last = name.split(".")[-1]
+        if last not in config.DISPATCH_CONSTRUCTS:
+            continue
+        # bare `jit` must come from jax to count; attribute forms
+        # (jax.jit, pl.pallas_call, jax.experimental...) always count
+        line = node.lineno
+        ok, reason = ctx.allowed(line, "dispatch")
+        yield Finding(
+            "VL101", "dispatch", ctx.path, line,
+            f"dispatch-creating construct `{name}` outside the device "
+            "layers (ops/, engine/) — the perf model cannot see "
+            "programs born here",
+            suppressed=ok, reason=reason,
+        )
+
+
+def _check_host_sync(ctx: FileContext):
+    path = _norm(ctx.path)
+    serving: list[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = _qualname(ctx, node)
+            for suffix, want in config.SERVING_PATH_FUNCTIONS:
+                if path.endswith(suffix) and qn == want:
+                    serving.append(node)
+    for func in serving:
+        fa, freason = ctx.func_allowed(func, "host-sync")
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = None
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in config.HOST_SYNC_METHODS and not node.args:
+                    hit = f".{attr}()"
+                elif attr in config.HOST_SYNC_CALLS:
+                    base = _dotted(node.func.value)
+                    if base in ("np", "numpy", "_np", "jax"):
+                        hit = f"{base}.{attr}(...)"
+            if hit is None:
+                continue
+            line = node.lineno
+            ok, reason = ctx.allowed(line, "host-sync")
+            if not ok and fa:
+                ok, reason = True, freason
+            yield Finding(
+                "VL102", "host-sync", ctx.path, line,
+                f"host-device sync `{hit}` inside serving-path "
+                f"function `{func.name}` — stalls the request on "
+                "device completion; justify inline if intended",
+                suppressed=ok, reason=reason,
+            )
+
+
+register(Rule(
+    id="VL101", tag="dispatch",
+    doc="jit/pallas_call/pmap/shard_map only in ops/ and engine/",
+    check_file=_check_dispatch,
+))
+
+register(Rule(
+    id="VL102", tag="host-sync",
+    doc="no unjustified host-device sync inside serving-path functions",
+    check_file=_check_host_sync,
+))

@@ -1,4 +1,19 @@
 import os
+import time
+
+# Span epochs are derived from monotonic measurements plus this
+# process-constant anchor: durations must survive wall-clock steps
+# (lint VL203), and a later NTP step merely shifts where spans sit on
+# the collector's absolute timeline. Shared by every module whose
+# timestamps cross function boundaries before span emission (engine
+# phases, ivf dispatch capture, microbatch queue waits).
+MONO_EPOCH_OFFSET = time.time() - time.monotonic()  # lint: allow[wall-clock] span epoch anchor, captured once at import
+
+
+def mono_us(t_monotonic: float) -> int:
+    """Monotonic seconds -> wall-anchored epoch microseconds, the
+    `start_us` convention of the tracing layer."""
+    return int((MONO_EPOCH_OFFSET + t_monotonic) * 1e6)
 
 
 def apply_jax_platform_env() -> None:
