@@ -1,0 +1,260 @@
+"""Elastic data-plane acceptance (tier-1): online partition split under
+concurrent traffic, and snapshot-streamed replica migration + drain to
+a freshly joined PS — the two end-to-end contracts of
+docs/ELASTICITY.md, asserted through the public surfaces only (SDK,
+router, master REST, /metrics)."""
+
+import threading
+import urllib.request
+
+import numpy as np
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def _mk_space(cl, partition_num=1, replica_num=1):
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": partition_num,
+        "replica_num": replica_num,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+
+
+def _all_ids(cl, expect_at_most):
+    docs = cl.query("db", "s", limit=expect_at_most + 50, fields=[])
+    return [d["_id"] for d in docs]
+
+
+def test_online_split_under_concurrent_traffic(tmp_path, rng):
+    """Split a partition while writers and searchers hammer it: zero
+    lost docs, zero duplicated docs, read-your-writes holds across the
+    cutover, and the router serves the children afterwards."""
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2)
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        _mk_space(cl)
+        vecs = rng.standard_normal((1200, D)).astype(np.float32)
+        seed_ids = [f"seed{i}" for i in range(300)]
+        cl.upsert("db", "s", [{"_id": k, "v": vecs[i].tolist()}
+                              for i, k in enumerate(seed_ids)])
+        space0 = cl.get_space("db", "s")
+        parent = space0["partitions"][0]["id"]
+        assert len(space0["partitions"]) == 1
+
+        acked: list[str] = []
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def writer(tid: int):
+            i = 0
+            try:
+                while not stop.is_set():
+                    ids = [f"w{tid}_{i + j}" for j in range(10)]
+                    cl.upsert("db", "s", [
+                        {"_id": k, "v": vecs[(300 + i + j) % 1200].tolist()}
+                        for j, k in enumerate(ids)
+                    ])
+                    acked.extend(ids)  # list.append is atomic; ids unique
+                    i += 10
+            except Exception as e:
+                errors.append(e)
+
+        def searcher():
+            try:
+                while not stop.is_set():
+                    out = cl.search(
+                        "db", "s", [{"field": "v", "feature": vecs[0]}],
+                        limit=3)
+                    assert len(out) == 1 and out[0]
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+                   for t in range(2)]
+        threads += [threading.Thread(target=searcher, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            job = cl.split_partition("db", "s", parent, timeout_s=120.0)
+            done = cl.wait_elastic_job(job["job_id"], timeout_s=120.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert not errors, errors
+        assert done["status"] == "done" and done["op"] == "split"
+
+        # the router now serves two children; the parent is gone
+        space1 = cl.get_space("db", "s")
+        child_ids = [p["id"] for p in space1["partitions"]]
+        assert len(child_ids) == 2 and parent not in child_ids
+        assert space1.get("map_version", 0) > space0.get("map_version", 0)
+
+        # zero lost, zero duplicated: the union of everything acked is
+        # exactly what a full scan returns (sorted-id pagination would
+        # surface a duplicate as an extra row)
+        expected = sorted(set(seed_ids) | set(acked))
+        assert len(expected) == len(seed_ids) + len(acked)
+        got = _all_ids(cl, len(expected))
+        assert sorted(got) == expected, (
+            f"{len(expected)} acked vs {len(got)} served"
+        )
+        # read-your-writes by id across the cutover
+        if acked:
+            probe = acked[-20:]
+            found = cl.query("db", "s", document_ids=probe, fields=[])
+            assert sorted(d["_id"] for d in found) == sorted(probe)
+        # searches land on the children
+        out = cl.search("db", "s", [{"field": "v", "feature": vecs[0]}],
+                        limit=5)
+        assert out[0]
+
+        # observability: the PS-side job is readable at /ps/jobs, the
+        # master rolled the split into /cluster/health, and the
+        # counter moved
+        ps_jobs = [
+            j
+            for ps in c.ps_nodes
+            for j in rpc.call(ps.addr, "GET", "/ps/jobs")["jobs"]
+            if j.get("op") == "split"
+        ]
+        assert ps_jobs and any(j["partition_id"] == parent
+                               for j in ps_jobs)
+        health = rpc.call(c.master_addr, "GET", "/cluster/health")
+        for key in ("splits_running", "splits_failed",
+                    "migrations_running", "elastic_jobs_running",
+                    "elastic_jobs_failed"):
+            assert key in health
+        # the split rollup is heartbeat-fed: it drains within a beat
+        # of the parent's retirement
+        import time as _time
+        for _ in range(50):
+            if rpc.call(c.master_addr, "GET",
+                        "/cluster/health")["splits_running"] == 0:
+                break
+            _time.sleep(0.1)
+        else:
+            raise AssertionError("splits_running never drained")
+        text = urllib.request.urlopen(
+            f"http://{c.master_addr}/metrics").read().decode()
+        assert 'vearch_partition_splits_total{status="done"} 1' in text
+    finally:
+        c.stop()
+
+
+def test_migrate_to_fresh_ps_then_drain_source(tmp_path, rng):
+    """Join a brand-new PS, stream a replica onto it, then drain the
+    original PS empty — with a searcher asserting zero failed queries
+    throughout, and progress visible via /cluster/jobs, /cluster/health
+    and the migration counter."""
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1)
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        _mk_space(cl, partition_num=2)
+        vecs = rng.standard_normal((200, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i].tolist()}
+                              for i in range(200)])
+        src = c.ps_nodes[0].node_id
+        fresh = c.add_ps()
+        # the master must see the new node before it can be a target
+        deadline = 50
+        import time as _time
+        for _ in range(deadline * 10):
+            servers = rpc.call(c.master_addr, "GET", "/servers")["servers"]
+            if any(s["node_id"] == fresh.node_id for s in servers):
+                break
+            _time.sleep(0.1)
+        else:
+            raise AssertionError("fresh PS never registered")
+
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def searcher():
+            try:
+                while not stop.is_set():
+                    out = cl.search(
+                        "db", "s", [{"field": "v", "feature": vecs[0]}],
+                        limit=3)
+                    assert len(out) == 1 and out[0]
+            except Exception as e:
+                errors.append(e)
+
+        t = threading.Thread(target=searcher, daemon=True)
+        t.start()
+        try:
+            pid = cl.get_space("db", "s")["partitions"][0]["id"]
+            job = cl.migrate_partition(pid, to_node=fresh.node_id,
+                                       timeout_s=120.0)
+            done = cl.wait_elastic_job(job["job_id"], timeout_s=120.0)
+            assert done["status"] == "done" and done["op"] == "migrate"
+            assert done["detail"]["to_node"] == fresh.node_id
+
+            # the moved partition now lives on the fresh node only
+            part = next(p for p in cl.get_space("db", "s")["partitions"]
+                        if p["id"] == pid)
+            assert part["replicas"] == [fresh.node_id]
+            assert part["leader"] == fresh.node_id
+
+            # drain the source PS empty (its remaining partition moves)
+            out = cl.drain(src, apply=True)
+            assert out.get("job_id"), out
+            cl.wait_elastic_job(out["job_id"], timeout_s=120.0)
+        finally:
+            stop.set()
+            t.join(timeout=60)
+        assert not errors, [repr(e) for e in errors[:3]]
+
+        servers = rpc.call(c.master_addr, "GET", "/servers")["servers"]
+        drained = next(s for s in servers if s["node_id"] == src)
+        assert drained["partition_ids"] == [], "source PS not empty"
+        # data survived both hops
+        assert len(_all_ids(cl, 200)) == 200
+        out = cl.search("db", "s", [{"field": "v", "feature": vecs[5]}],
+                        limit=3)
+        assert out[0]
+
+        # observability: job registry lists both jobs, health has the
+        # rollup, and the counter moved once per completed move
+        jobs = cl.elastic_jobs()
+        assert {j["op"] for j in jobs} >= {"migrate", "drain"}
+        assert all(j["status"] == "done" for j in jobs)
+        health = rpc.call(c.master_addr, "GET", "/cluster/health")
+        assert health["migrations_running"] == 0
+        assert health["elastic_jobs_failed"] == 0
+        text = urllib.request.urlopen(
+            f"http://{c.master_addr}/metrics").read().decode()
+        assert 'vearch_replica_migrations_total{status="done"} 2' in text
+        assert "vearch_cluster_imbalance_score" in text
+    finally:
+        c.stop()
+
+
+def test_plan_and_rebalance_endpoints(tmp_path, rng):
+    """/cluster/plan and a no-op rebalance round-trip on a healthy
+    cluster; rebalance without `apply` never mutates anything."""
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1)
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        _mk_space(cl)
+        plan = cl.cluster_plan()
+        for key in ("imbalance", "node_loads", "moves", "splits"):
+            assert key in plan
+        out = cl.rebalance(apply=False)
+        assert out["applied"] is False
+        before = cl.get_space("db", "s")
+        out = cl.rebalance(apply=True)  # balanced: nothing to do
+        assert "job_id" not in out
+        assert cl.get_space("db", "s") == before
+    finally:
+        c.stop()

@@ -1,0 +1,166 @@
+"""Elasticity chaos: kill the PS on the receiving end of a migration
+and of a split mid-flight. The invariants under fire: the source keeps
+serving, failed jobs land terminal (never wedge "running"), children
+of a failed split are garbage-collected so a retry starts clean, and
+no acked doc is ever lost."""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def _mk_space(cl, partition_num=1):
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": partition_num, "replica_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+
+
+def _wait_registered(master_addr, node_id, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        servers = rpc.call(master_addr, "GET", "/servers")["servers"]
+        if any(s["node_id"] == node_id for s in servers):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"PS {node_id} never registered")
+
+
+def _wait_job(cl, job_id, timeout_s=90.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        job = cl.elastic_job(job_id)
+        if job["status"] != "running":
+            return job
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} still running after {timeout_s}s")
+
+
+def test_kill_target_ps_mid_migration(tmp_path, rng):
+    """The migration target dies mid-catchup: the job must land
+    terminal, the source must keep serving throughout, and every doc
+    must survive. (A racy win — the job finishing before the kill
+    lands — is accepted; the cluster must be consistent either way.)"""
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1)
+    c.start()
+    target = None
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        _mk_space(cl)
+        vecs = rng.standard_normal((400, D)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i].tolist()}
+                              for i in range(400)])
+        pid = cl.get_space("db", "s")["partitions"][0]["id"]
+        src = c.ps_nodes[0].node_id
+        target = c.add_ps()
+        _wait_registered(c.master_addr, target.node_id)
+
+        job = cl.migrate_partition(pid, to_node=target.node_id,
+                                   timeout_s=6.0)
+        target.stop(flush=False)
+        c.ps_nodes.remove(target)
+
+        # the source serves searches while the job is dying
+        out = cl.search("db", "s", [{"field": "v", "feature": vecs[0]}],
+                        limit=3)
+        assert out[0]
+
+        done = _wait_job(cl, job["job_id"])
+        assert done["status"] in ("done", "error")
+        part = next(p for p in cl.get_space("db", "s")["partitions"]
+                    if p["id"] == pid)
+        if done["status"] == "error":
+            # membership untouched: the learner never joined the quorum
+            assert part["replicas"] == [src]
+            assert part["leader"] == src
+        else:  # won the race before the kill: replica moved wholesale
+            assert part["replicas"] == [target.node_id]
+        # zero data loss either way (an errored job must leave the
+        # source's copy fully intact and routed)
+        docs = cl.query("db", "s", limit=500, fields=[])
+        assert len(docs) == 400
+        out = cl.search("db", "s", [{"field": "v", "feature": vecs[7]}],
+                        limit=3)
+        assert out[0]
+    finally:
+        c.stop()
+
+
+def test_crash_ps_hosting_children_mid_split(tmp_path, rng):
+    """The PS hosting the split children crashes during the copy: the
+    job errors, the children are garbage-collected, the parent is
+    intact and still routed — and a retry (against the surviving PS)
+    succeeds."""
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1)
+    c.start()
+    victim = None
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        _mk_space(cl)
+        vecs = rng.standard_normal((1500, D)).astype(np.float32)
+        for lo in range(0, 1500, 500):
+            cl.upsert("db", "s", [
+                {"_id": f"d{i}", "v": vecs[i].tolist()}
+                for i in range(lo, lo + 500)])
+        space0 = cl.get_space("db", "s")
+        parent = space0["partitions"][0]["id"]
+
+        # join an empty PS: least-loaded placement puts both children
+        # there, making it the perfect crash victim
+        victim = c.add_ps()
+        _wait_registered(c.master_addr, victim.node_id)
+
+        job = cl.split_partition("db", "s", parent, timeout_s=60.0)
+        time.sleep(0.05)  # let the copy start
+        victim.stop(flush=False)
+        c.ps_nodes.remove(victim)
+        victim_id = victim.node_id
+
+        done = _wait_job(cl, job["job_id"])
+        assert done["status"] == "error", done
+
+        # parent intact and still the only routed partition
+        space1 = cl.get_space("db", "s")
+        assert [p["id"] for p in space1["partitions"]] == [parent]
+        assert len(cl.query("db", "s", limit=1600, fields=[])) == 1500
+        # children GC'd from the metadata: no server claims them
+        servers = rpc.call(c.master_addr, "GET", "/servers")["servers"]
+        for s in servers:
+            for cid in done["detail"].get("children", []):
+                assert cid not in s["partition_ids"], (
+                    f"child {cid} leaked on node {s['node_id']}")
+
+        # wait for the victim's lease to expire (heartbeat TTL) so the
+        # retry places children on the surviving node only
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            servers = rpc.call(c.master_addr, "GET",
+                               "/servers")["servers"]
+            if not any(s["node_id"] == victim_id for s in servers):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("victim PS lease never expired")
+
+        job = cl.split_partition("db", "s", parent, timeout_s=120.0)
+        done = cl.wait_elastic_job(job["job_id"], timeout_s=120.0)
+        assert done["status"] == "done"
+        space2 = cl.get_space("db", "s")
+        kids = [p["id"] for p in space2["partitions"]]
+        assert len(kids) == 2 and parent not in kids
+        assert len(cl.query("db", "s", limit=1600, fields=[])) == 1500
+        out = cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
+                        limit=3)
+        assert out[0]
+    finally:
+        c.stop()

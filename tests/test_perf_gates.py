@@ -286,6 +286,74 @@ def test_cached_search_adds_zero_dispatches_and_zero_programs(tmp_path):
         c.stop()
 
 
+def test_partition_split_adds_zero_dispatches_to_serving(tmp_path):
+    """The elasticity contract on the device ledger: an entire online
+    partition split is host-side work (engine key scans, doc re-reads,
+    child-forward RPCs) — it launches ZERO device dispatches of its
+    own, and once the post-split shapes have settled, repeated
+    identical searches against the children again dispatch nothing and
+    compile nothing (the router cache serves them)."""
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    d = 16
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=1)
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1,
+            "fields": [
+                {"name": "v", "data_type": "vector", "dimension": d,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        rng = np.random.default_rng(11)
+        vecs = rng.standard_normal((300, d)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(300)])
+        parent = cl.get_space("db", "s")["partitions"][0]["id"]
+        cl.search("db", "s", [{"field": "v", "feature": vecs[0]}],
+                  limit=5)  # warm the pre-split serving path
+
+        # the split itself: zero device work
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            job = cl.split_partition("db", "s", parent, timeout_s=120.0)
+            cl.wait_elastic_job(job["job_id"], timeout_s=120.0)
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        assert ledger.tags == [], (
+            f"partition split reached the device: {ledger.tags}"
+        )
+
+        # settle the post-split shapes (children are new engines; the
+        # first search may trace), then gate steady state
+        cl.search("db", "s", [{"field": "v", "feature": vecs[0]}],
+                  limit=5)
+        before = perf_model.total_compiled_programs()
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            for _ in range(5):
+                cl.search("db", "s",
+                          [{"field": "v", "feature": vecs[0]}], limit=5)
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        assert ledger.tags == [], (
+            f"post-split repeated searches reached the device: "
+            f"{ledger.tags}"
+        )
+        assert perf_model.total_compiled_programs() == before, (
+            "post-split warmed searches compiled new programs"
+        )
+    finally:
+        c.stop()
+
+
 # -- gate 3: bytes materialized ----------------------------------------------
 
 

@@ -1,0 +1,183 @@
+"""Replica placement + rebalance planner unit tests (cluster/elastic.py).
+
+The planner is pure functions over entities + heartbeat stats, so these
+run with no servers: strict anti-affinity (replicas of one partition
+never co-locate), least-loaded preference, deterministic tie-breaks,
+and plan computation (moves that shrink the hot/cold gap, split
+suggestions for heat concentrated in one partition).
+"""
+
+import pytest
+
+from vearch_tpu.cluster import elastic
+from vearch_tpu.cluster.entities import Partition, Server, Space
+from vearch_tpu.cluster.hashing import MAX_UINT32
+
+
+def _space(replica_num=1, partitions=(), **kw):
+    return Space(id=1, name="s", db_name="db", schema=None,
+                 replica_num=replica_num,
+                 partitions=list(partitions), **kw)
+
+
+def _part(pid, slot, replicas, leader=None):
+    return Partition(id=pid, space_id=1, db_name="db", space_name="s",
+                     slot=slot, replicas=list(replicas),
+                     leader=replicas[0] if leader is None else leader)
+
+
+def _srv(nid, pids=(), labels=None):
+    return Server(node_id=nid, rpc_addr=f"h{nid}:1",
+                  partition_ids=list(pids), labels=labels or {})
+
+
+# -- place_replicas ----------------------------------------------------------
+
+
+def test_never_colocates_replicas():
+    sp = _space(replica_num=3)
+    chosen = elastic.place_replicas(
+        sp, [_srv(1), _srv(2), _srv(3), _srv(4)])
+    assert len(chosen) == 3
+    assert len(set(chosen)) == 3, "replicas co-located on one PS"
+
+
+def test_too_few_servers_raises_instead_of_doubling_up():
+    sp = _space(replica_num=3)
+    with pytest.raises(ValueError, match="co-locating"):
+        elastic.place_replicas(sp, [_srv(1), _srv(2)])
+    # duplicate registrations of one node don't count as capacity
+    with pytest.raises(ValueError, match="co-locating"):
+        elastic.place_replicas(sp, [_srv(1), _srv(1), _srv(2)])
+
+
+def test_prefers_least_loaded_by_reported_bytes():
+    sp = _space(replica_num=1)
+    stats = {
+        1: {"10": {"size_bytes": 5_000_000}},
+        2: {"11": {"size_bytes": 100}},
+        3: {},  # freshly joined, nothing heartbeated: load 0
+    }
+    servers = [_srv(1, [10]), _srv(2, [11]), _srv(3)]
+    assert elastic.place_replicas(sp, servers, stats) == [3]
+    two = elastic.place_replicas(_space(replica_num=2), servers, stats)
+    assert two == [3, 2]  # ascending load order
+
+
+def test_partition_count_then_node_id_break_ties():
+    sp = _space(replica_num=2)
+    # equal load (no stats): fewer hosted partitions wins, then the
+    # lower node id — same inputs must always give the same placement
+    servers = [_srv(3, [1, 2]), _srv(2, [7]), _srv(1, [8])]
+    assert elastic.place_replicas(sp, servers, {}) == [1, 2]
+    assert elastic.place_replicas(sp, list(reversed(servers)), {}) \
+        == [1, 2]
+
+
+def test_label_anti_affinity_soft_preference():
+    sp = _space(replica_num=2, anti_affinity="rack")
+    servers = [
+        _srv(1, labels={"rack": "a"}),
+        _srv(2, labels={"rack": "a"}),
+        _srv(3, labels={"rack": "b"}),
+    ]
+    chosen = elastic.place_replicas(sp, servers, {})
+    racks = {{1: "a", 2: "a", 3: "b"}[n] for n in chosen}
+    assert racks == {"a", "b"}  # spread across racks when possible
+    # topology too small: falls back to label collision, still two
+    # DISTINCT nodes
+    small = elastic.place_replicas(sp, servers[:2], {})
+    assert len(set(small)) == 2
+
+
+# -- imbalance / plan --------------------------------------------------------
+
+
+def test_imbalance_score_degenerate_and_spread():
+    assert elastic.imbalance_score([]) == 0.0
+    assert elastic.imbalance_score([7.0]) == 0.0
+    assert elastic.imbalance_score([0.0, 0.0]) == 0.0
+    assert elastic.imbalance_score([10.0, 10.0]) == 0.0
+    assert elastic.imbalance_score([30.0, 10.0]) == 1.0
+
+
+def test_compute_plan_moves_level_the_gap():
+    sp = _space(partitions=[
+        _part(10, 0, [1]), _part(11, 1000, [1]), _part(12, 2000, [2]),
+    ])
+    stats = {
+        1: {"10": {"size_bytes": 900, "searches_total": 1,
+                   "writes_total": 0},
+            "11": {"size_bytes": 800, "searches_total": 1,
+                   "writes_total": 0}},
+        2: {"12": {"size_bytes": 100, "searches_total": 1,
+                   "writes_total": 0}},
+        3: {},
+    }
+    servers = [_srv(1, [10, 11]), _srv(2, [12]), _srv(3)]
+    plan = elastic.compute_plan([sp], servers, stats)
+    assert plan["imbalance"] > 0.25
+    assert plan["moves"], "imbalanced cluster produced no moves"
+    mv = plan["moves"][0]
+    assert mv["from_node"] == 1 and mv["to_node"] == 3
+    # a move never lands on a node already holding a replica
+    for m in plan["moves"]:
+        assert m["to_node"] not in {
+            p.replicas[0] for p in sp.partitions
+            if p.id == m["partition_id"]}
+    # deterministic: same inputs, same plan
+    assert elastic.compute_plan([sp], servers, stats) == plan
+
+
+def test_compute_plan_balanced_cluster_is_a_noop():
+    sp = _space(partitions=[_part(10, 0, [1]), _part(11, 1000, [2])])
+    stats = {1: {"10": {"size_bytes": 500}},
+             2: {"11": {"size_bytes": 500}}}
+    plan = elastic.compute_plan([sp], [_srv(1, [10]), _srv(2, [11])],
+                                stats)
+    assert plan["moves"] == []
+
+
+def test_compute_plan_suggests_split_for_hot_partition():
+    sp = _space(partitions=[_part(10, 0, [1]), _part(11, 1 << 31, [2])])
+    stats = {
+        1: {"10": {"size_bytes": 100, "searches_total": 980,
+                   "writes_total": 0}},
+        2: {"11": {"size_bytes": 100, "searches_total": 20,
+                   "writes_total": 0}},
+    }
+    plan = elastic.compute_plan([sp], [_srv(1, [10]), _srv(2, [11])],
+                                stats)
+    assert [s["partition_id"] for s in plan["splits"]] == [10]
+    # evenly spread heat suggests nothing
+    stats[2]["11"]["searches_total"] = 980
+    plan = elastic.compute_plan([sp], [_srv(1, [10]), _srv(2, [11])],
+                                stats)
+    assert plan["splits"] == []
+
+
+# -- split_ranges ------------------------------------------------------------
+
+
+def test_split_ranges_halves_the_slot_span():
+    sp = _space(partitions=[_part(10, 0, [1]), _part(11, 1 << 31, [1])])
+    lo, mid, hi = elastic.split_ranges(sp, 10)
+    assert (lo, mid, hi) == (0, 1 << 30, 1 << 31)
+    lo, mid, hi = elastic.split_ranges(sp, 11)
+    assert lo == 1 << 31 and hi == MAX_UINT32 + 1 and lo < mid < hi
+
+
+def test_split_ranges_refuses_structurally_unsplittable():
+    rule = _space(partitions=[_part(10, 0, [1])],
+                  partition_rule={"type": "RANGE", "field": "f",
+                                  "ranges": []})
+    with pytest.raises(ValueError, match="rule spaces"):
+        elastic.split_ranges(rule, 10)
+    exp = _space(partitions=[_part(10, 0, [1])], expanded=True)
+    with pytest.raises(ValueError, match="off-slot"):
+        elastic.split_ranges(exp, 10)
+    sp = _space(partitions=[_part(10, 0, [1]), _part(11, 1, [1])])
+    with pytest.raises(ValueError, match="too\\s+narrow"):
+        elastic.split_ranges(sp, 10)
+    with pytest.raises(ValueError, match="not in space"):
+        elastic.split_ranges(sp, 99)

@@ -13,10 +13,22 @@ import sys
 import threading
 
 
+ELASTIC_VERBS = ("rebalance", "drain", "split", "migrate", "plan", "jobs")
+
+
 def main(argv: list[str] | None = None) -> int:
     from vearch_tpu.utils import apply_jax_platform_env
 
     apply_jax_platform_env()
+
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] in ELASTIC_VERBS:
+        # operator verbs (`vearch_tpu rebalance`, `vearch_tpu drain 3`)
+        # delegate to the elasticity CLI — same binary, no role daemon
+        from vearch_tpu.tools.elastic_cli import main as elastic_main
+
+        return elastic_main(argv)
 
     ap = argparse.ArgumentParser(prog="vearch_tpu")
     ap.add_argument("--role", default="standalone",

@@ -39,6 +39,15 @@ class Partition:
     # partition-rule group this partition belongs to (the range name;
     # reference: entity/partition.go Partition.Name under PartitionRule)
     group: str | None = None
+    # non-voting replication targets (raft learners): they receive
+    # appends/snapshots and report lag but never count toward quorum or
+    # campaign — the replica-migration catch-up state (reference:
+    # etcd-raft learner semantics)
+    learners: list[int] = field(default_factory=list)
+    # routing-map epoch this partition was minted under; responses echo
+    # it so routers detect a split cutover without waiting for the
+    # metastore watch
+    map_version: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return dict(self.__dict__)
@@ -79,6 +88,9 @@ class Space:
     # (table.py _key_to_docid — no FFI boundary to cache across), so the
     # cache is structurally always-on; the flag round-trips the API.
     enable_id_cache: bool = True
+    # partition-map epoch: bumped by every split cutover; routers
+    # compare against response-carried versions to hot-reload the map
+    map_version: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -100,6 +112,8 @@ class Space:
             d["expanded"] = True
         if self.pre_expand_pids:
             d["pre_expand_pids"] = list(self.pre_expand_pids)
+        if self.map_version:
+            d["map_version"] = self.map_version
         return d
 
     @classmethod
@@ -117,6 +131,7 @@ class Space:
             enable_id_cache=bool(d.get("enable_id_cache", True)),
             expanded=bool(d.get("expanded", False)),
             pre_expand_pids=[int(x) for x in d.get("pre_expand_pids", [])],
+            map_version=int(d.get("map_version", 0)),
         )
 
     def slot_starts(self) -> list[int]:

@@ -14,7 +14,7 @@ import time
 import uuid
 from typing import Any
 
-from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster import elastic, rpc
 from vearch_tpu.cluster.entities import (
     PREFIX_DB,
     PREFIX_SERVER,
@@ -43,6 +43,16 @@ def _deepcopy_job(job: dict) -> dict:
     out["results"] = list(job["results"])
     return out
 
+
+def _deepcopy_ejob(job: dict) -> dict:
+    """Stable snapshot of an elastic-job record for serving (same
+    reason as _deepcopy_job: the worker mutates nested state while
+    requests read it)."""
+    out = dict(job)
+    out["detail"] = dict(job.get("detail") or {})
+    out["steps"] = [dict(s) for s in job.get("steps") or []]
+    return out
+
 HEARTBEAT_TTL = 8.0
 
 
@@ -64,6 +74,8 @@ class MasterServer:
         meta_log_keep: int = 1000,
         meta_flush_every: int = 500,
         join: str | None = None,
+        auto_rebalance: bool = False,
+        rebalance_interval: float = 30.0,
     ):
         from vearch_tpu.cluster.auth import AuthService, parse_basic_auth
 
@@ -86,6 +98,15 @@ class MasterServer:
         # async backup jobs (reference: backup progress endpoints)
         self._backup_jobs: dict[str, dict] = {}
         self._backup_jobs_lock = threading.Lock()
+        # async elastic jobs: online splits, replica migrations, drains,
+        # and rebalance applications (each a first-class observable
+        # record: GET /cluster/jobs)
+        self._elastic_jobs: dict[str, dict] = {}
+        self._elastic_jobs_lock = threading.Lock()
+        # load-aware auto-rebalance closed loop — default OFF: the
+        # planner stays advisory until an operator opts in
+        self.auto_rebalance = bool(auto_rebalance)
+        self.rebalance_interval = float(rebalance_interval)
 
         # -- multi-master metadata group (reference: embedded etcd raft,
         # master/server.go:89). peers: {master_node_id: "host:port"}
@@ -189,6 +210,14 @@ class MasterServer:
         s.route("GET", "/config", self._h_get_config)
         s.route("POST", "/backup/dbs", self._h_backup)
         s.route("GET", "/backup/jobs", self._h_backup_jobs)
+        # elastic data plane: online split / migration / drain /
+        # rebalance operator verbs + job progress
+        s.route("POST", "/partitions/split", self._h_split)
+        s.route("POST", "/partitions/migrate", self._h_migrate)
+        s.route("POST", "/cluster/rebalance", self._h_rebalance)
+        s.route("POST", "/cluster/drain", self._h_drain)
+        s.route("GET", "/cluster/plan", self._h_plan)
+        s.route("GET", "/cluster/jobs", self._h_elastic_jobs)
         s.route("POST", "/alias", self._h_create_alias)
         # PUT modifies (reference: modifyAlias) — same upsert semantics
         s.route("PUT", "/alias", self._h_create_alias)
@@ -331,6 +360,37 @@ class MasterServer:
         m.callback_gauge("vearch_space_size_bytes",
                          "engine bytes per space", ("db", "space"),
                          _space_stat("size_bytes"))
+
+        def imbalance():
+            loads = elastic.node_loads(self._alive_servers(),
+                                       self._node_stats)
+            return {(): elastic.imbalance_score(loads.values())}
+
+        m.callback_gauge("vearch_cluster_imbalance_score",
+                         "(max-min)/mean of per-PS engine bytes",
+                         (), imbalance)
+
+        def elastic_running():
+            with self._elastic_jobs_lock:
+                n = sum(1 for j in self._elastic_jobs.values()
+                        if j["status"] == "running")
+            return {(): float(n)}
+
+        m.callback_gauge("vearch_elastic_jobs_running",
+                         "elastic jobs (split/migrate/drain/rebalance) "
+                         "in flight", (), elastic_running)
+
+        # outcome counters pre-seed both label values so dashboards see
+        # the full series set from the first scrape
+        self._m_splits = m.counter(
+            "vearch_partition_splits_total",
+            "completed partition-split jobs by outcome", ("status",))
+        self._m_migrations = m.counter(
+            "vearch_replica_migrations_total",
+            "completed replica-migration jobs by outcome", ("status",))
+        for st in ("done", "error"):
+            self._m_splits.inc(st, by=0.0)
+            self._m_migrations.inc(st, by=0.0)
 
     # -- multi-master plumbing ----------------------------------------------
 
@@ -541,6 +601,9 @@ class MasterServer:
         if self.auto_recover:
             threading.Thread(target=self._auto_recover_loop,
                              daemon=True, name="master-auto-recover").start()
+        if self.auto_rebalance:
+            threading.Thread(target=self._auto_rebalance_loop,
+                             daemon=True, name="master-rebalance").start()
         if self.join_addr and len(self.peers) <= 1:
             # register with the existing group (any member forwards the
             # POST to the leader); the response carries the full member
@@ -951,12 +1014,29 @@ class MasterServer:
         # partition id -> build status, as last heartbeated by any node
         # hosting it (leader wins when both report)
         builds: dict[int, str] = {}
+        splits: dict[int, str] = {}
         for nid, parts_stats in list(self._node_stats.items()):
             for pid_s, st in dict(parts_stats).items():
                 bs = st.get("build_status")
                 if bs and (st.get("leader") or int(pid_s) not in builds):
                     builds[int(pid_s)] = bs
+                ss = st.get("split_status")
+                if ss and (st.get("leader") or int(pid_s) not in splits):
+                    splits[int(pid_s)] = ss
         builds_running = builds_failed = 0
+        # elastic rollup: PS-side split jobs ride heartbeats; master-side
+        # job records (splits, migrations, drains, rebalances) live here
+        splits_running = sum(1 for v in splits.values() if v == "running")
+        splits_failed = sum(1 for v in splits.values() if v == "error")
+        with self._elastic_jobs_lock:
+            el_running = sum(1 for j in self._elastic_jobs.values()
+                             if j["status"] == "running")
+            el_failed = sum(1 for j in self._elastic_jobs.values()
+                            if j["status"] == "error")
+            migrations_running = sum(
+                1 for j in self._elastic_jobs.values()
+                if j["status"] == "running"
+                and j["op"] in ("migrate", "drain", "rebalance"))
         spaces = []
         worst = "green"
         rank = {"green": 0, "yellow": 1, "red": 2}
@@ -973,6 +1053,9 @@ class MasterServer:
                     pstat = "green"
                 entry = {"id": p["id"], "status": pstat,
                          "alive_replicas": len(alive)}
+                ss = splits.get(int(p["id"]))
+                if ss:
+                    entry["split"] = ss
                 bs = builds.get(int(p["id"]))
                 if bs:
                     entry["build"] = bs
@@ -989,7 +1072,12 @@ class MasterServer:
                 worst = status
         return {"status": worst if spaces else "green", "spaces": spaces,
                 "builds_running": builds_running,
-                "builds_failed": builds_failed}
+                "builds_failed": builds_failed,
+                "splits_running": splits_running,
+                "splits_failed": splits_failed,
+                "migrations_running": migrations_running,
+                "elastic_jobs_running": el_running,
+                "elastic_jobs_failed": el_failed}
 
     def _h_members(self, _body, _parts) -> dict:
         """Metadata-raft membership (reference: GET /members +
@@ -1845,6 +1933,670 @@ class MasterServer:
             return {"jobs": [_deepcopy_job(j)
                              for j in self._backup_jobs.values()]}
 
+    # -- elastic data plane: online split, snapshot-streamed replica
+    #    migration, load-aware rebalancing (reference: the partition
+    #    admin verbs in master/cluster_api.go + etcd-raft learner
+    #    promotion). Every verb runs as an observable async job:
+    #    GET /cluster/jobs, /cluster/health rollup, and the
+    #    vearch_partition_splits_total / vearch_replica_migrations_total
+    #    / vearch_elastic_jobs_running metrics. ---------------------------
+
+    def _new_elastic_job(self, op: str, detail: dict) -> dict:
+        from vearch_tpu.utils import prune_job_registry
+
+        job_id = f"{op}-{self.store.next_id('/seq/elastic_job')}"
+        job = {
+            "job_id": job_id, "op": op, "status": "running",
+            "phase": "init", "error": None,
+            "started": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+            "updated": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+            "detail": dict(detail), "steps": [],
+        }
+        with self._elastic_jobs_lock:
+            self._elastic_jobs[job_id] = job
+            prune_job_registry(self._elastic_jobs)
+        return job
+
+    def _ejob_update(self, job: dict, phase: str | None = None,
+                     **detail) -> None:
+        with self._elastic_jobs_lock:
+            if phase is not None:
+                job["phase"] = phase
+            job["detail"].update(detail)
+            job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+
+    def _ejob_finish(self, job: dict, error: str | None) -> None:
+        with self._elastic_jobs_lock:
+            job["status"] = "error" if error else "done"
+            job["error"] = error
+            job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+
+    def _h_elastic_jobs(self, _body, parts) -> dict:
+        """GET /cluster/jobs[/{job_id}] — elastic-job progress. Records
+        live on the leader (the workers run there), so followers forward
+        like the other leader-state GETs."""
+        fwd = self._leader_get(
+            "/cluster/jobs" + (f"/{parts[0]}" if parts else ""))
+        if fwd is not None:
+            return fwd
+        with self._elastic_jobs_lock:
+            if parts:
+                job = self._elastic_jobs.get(parts[0])
+                if job is None:
+                    raise RpcError(404, f"no elastic job {parts[0]}")
+                return _deepcopy_ejob(job)
+            return {"jobs": [_deepcopy_ejob(j)
+                             for j in self._elastic_jobs.values()]}
+
+    def _find_partition(self, pid: int):
+        """(space key, space dict, partition dict) or None."""
+        for key, sp in self.store.prefix(PREFIX_SPACE).items():
+            for p in sp["partitions"]:
+                if int(p["id"]) == pid:
+                    return key, sp, p
+        return None
+
+    def _load_spaces(self) -> list[Space]:
+        return [Space.from_dict(d)
+                for d in self.store.prefix(PREFIX_SPACE).values()]
+
+    # -- online partition split ----------------------------------------------
+
+    def _h_split(self, body: dict, _parts) -> dict:
+        """POST /partitions/split {db_name, space_name, partition_id} —
+        split a hot partition online: hash-range halves, PS-side
+        copy + double-write mirror, atomic versioned router-map flip.
+        Validates synchronously, then runs as an observable async job
+        (poll GET /cluster/jobs/{job_id})."""
+        db, name = body["db_name"], body["space_name"]
+        pid = int(body["partition_id"])
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        sp = self.store.get(key)
+        if sp is None:
+            raise RpcError(404, f"space {db}/{name} not found")
+        space = Space.from_dict(sp)
+        try:
+            elastic.split_ranges(space, pid)
+        except ValueError as e:
+            raise RpcError(400, str(e)) from None
+        parent = next(p for p in space.partitions if p.id == pid)
+        servers = {s.node_id: s for s in self._alive_servers()}
+        if parent.leader not in servers:
+            raise RpcError(503, f"leader of partition {pid} down")
+        timeout_s = float(body.get("timeout_s", 600.0))
+        # the worker owns the space lock from here (the async-backup
+        # idiom: held with TTL refresh for the job's real duration)
+        token = self._lock_space(db, name)
+        job = self._new_elastic_job("split", {
+            "db": db, "space": name, "partition_id": pid,
+            "children": [], "ps_phase": None,
+            "docs_done": 0, "docs_total": 0,
+        })
+        try:
+            threading.Thread(
+                target=self._run_split_job,
+                args=(job, db, name, pid, token, timeout_s),
+                daemon=True, name=f"elastic-{job['job_id']}").start()
+        except BaseException:
+            self._unlock_space(db, name, token)
+            raise
+        return {"job_id": job["job_id"], "status": "running",
+                "partition_id": pid}
+
+    def _run_split_job(self, job, db, name, pid, token,
+                       timeout_s) -> None:
+        key = f"{PREFIX_SPACE}{db}/{name}"
+        lock_name = f"space_mutate/{db}/{name}"
+        children: list[Partition] = []
+        leader_addr = None
+        started = flipped = False
+        err = None
+        try:
+            # re-read under the held lock: the handler's check was
+            # advisory and the space may have mutated since
+            sp = self.store.get(key)
+            if sp is None:
+                raise RpcError(404, f"space {db}/{name} vanished")
+            space = Space.from_dict(sp)
+            parent = next(
+                (p for p in space.partitions if p.id == pid), None)
+            if parent is None:
+                raise RpcError(404, f"partition {pid} not in {db}/{name}")
+            try:
+                lo, mid, hi = elastic.split_ranges(space, pid)
+            except ValueError as e:
+                raise RpcError(400, str(e)) from None
+            servers = {s.node_id: s for s in self._alive_servers()}
+            leader_srv = servers.get(parent.leader)
+            if leader_srv is None:
+                raise RpcError(503, f"leader of partition {pid} down")
+            leader_addr = leader_srv.rpc_addr
+
+            # 1. mint + place + create the children. NOT yet routed:
+            # they join the space record only at the atomic flip below,
+            # so a crash before that leaves the parent serving alone
+            # and the children as garbage the error path collects.
+            self._ejob_update(job, phase="create_children")
+            bounds = ((lo, mid), (mid, hi))
+            for slo, _shi in bounds:
+                cid = self.store.next_id(SEQ_PARTITION_ID)
+                replicas = self._place_replicas(
+                    space, list(servers.values()))
+                child = Partition(
+                    id=cid, space_id=space.id, db_name=db,
+                    space_name=name, slot=slo, replicas=replicas,
+                    leader=replicas[0], group=parent.group,
+                    # minted under the post-flip epoch: responses from
+                    # the children tell stale routers to reload
+                    map_version=space.map_version + 1,
+                )
+                children.append(child)
+                for nid in replicas:
+                    srv = servers[nid]
+                    rpc.call(srv.rpc_addr, "POST",
+                             "/ps/partition/create",
+                             {"partition": child.to_dict(),
+                              "schema": space.schema.to_dict()})
+                    srv.partition_ids.append(cid)
+                    self.store.put(f"{PREFIX_SERVER}{nid}",
+                                   srv.to_dict())
+            self._ejob_update(job, children=[c.id for c in children])
+
+            # 2. PS-side pipeline on the parent leader: bulk copy →
+            # mirror catch-up → synchronous double-writes → cutover_ready
+            self._ejob_update(job, phase="copy")
+            wire = [{"id": c.id, "slot_lo": b[0], "slot_hi": b[1],
+                     "leader": c.leader}
+                    for c, b in zip(children, bounds)]
+            rpc.call(leader_addr, "POST", "/ps/partition/split/start",
+                     {"partition_id": pid, "children": wire},
+                     timeout=30.0)
+            started = True
+            deadline = time.monotonic() + timeout_s
+            misses = 0
+            while True:
+                # same-owner try_lock refreshes the space-lock TTL for
+                # the job's real duration (the backup worker's idiom)
+                self.store.try_lock(lock_name, token, ttl_s=600.0)
+                try:
+                    st = rpc.call(
+                        leader_addr, "GET",
+                        f"/ps/partition/split/progress?partition_id={pid}")
+                    misses = 0
+                except RpcError:
+                    # tolerate transient poll failures; a dead parent
+                    # leader surfaces as 10 consecutive misses
+                    misses += 1
+                    if misses >= 10:
+                        raise
+                    time.sleep(0.3)
+                    continue
+                self._ejob_update(
+                    job, ps_phase=st.get("phase"),
+                    docs_done=st.get("docs_done", 0),
+                    docs_total=st.get("docs_total", 0),
+                    mirrored=st.get("mirrored", 0))
+                if st.get("status") == "error":
+                    raise RpcError(
+                        503, f"ps split failed: {st.get('error')}")
+                if st.get("phase") == "cutover_ready":
+                    break
+                if time.monotonic() > deadline:
+                    raise RpcError(503, "split copy/catch-up timed out")
+                time.sleep(0.25)
+
+            # 3. atomic router flip: ONE versioned store.put swaps the
+            # parent for its children — the watch fires and routers
+            # reload; a stale router that still writes to the parent
+            # converges via the response-carried map_version (the
+            # parent keeps sync-mirroring until deleted)
+            self._ejob_update(job, phase="cutover")
+            sp = self.store.get(key)
+            space = Space.from_dict(sp)
+            keep = [p for p in space.partitions if p.id != pid]
+            space.partitions = sorted(keep + children,
+                                      key=lambda p: p.slot)
+            if not space.partition_rule:
+                space.partition_num = len(space.partitions)
+            space.map_version += 1
+            self.store.put(key, space.to_dict())
+            flipped = True
+
+            # 4. commit on the parent leader (releases the sync-write
+            # window), then retire the parent everywhere — the delete
+            # IS the PS job's finalization (it drains the mirror first)
+            rpc.call(leader_addr, "POST", "/ps/partition/split/finish",
+                     {"partition_id": pid, "commit": True}, timeout=60.0)
+            self._ejob_update(job, phase="retire_parent")
+            self._drop_partitions([parent], list(servers.values()))
+        except RpcError as e:
+            err = e.msg
+        except Exception as e:  # the record must never stick "running"
+            _log.error("split job %s failed: %s: %s", job["job_id"],
+                       type(e).__name__, e)
+            err = f"{type(e).__name__}: {e}"
+        finally:
+            if err is not None and not flipped:
+                # failed before the flip: abort the PS-side mirror and
+                # garbage-collect the children so a retry starts clean;
+                # the parent keeps serving untouched
+                if started and leader_addr:
+                    try:
+                        rpc.call(leader_addr, "POST",
+                                 "/ps/partition/split/finish",
+                                 {"partition_id": pid, "commit": False},
+                                 timeout=60.0)
+                    except RpcError:
+                        pass  # parent PS gone: its job died with it
+                try:
+                    self._drop_partitions(children,
+                                          self._alive_servers())
+                except Exception as e:
+                    _log.error("split %s: child GC failed: %s: %s",
+                               job["job_id"], type(e).__name__, e)
+            self._m_splits.inc("error" if err else "done")
+            self._ejob_finish(job, err)
+            self._unlock_space(db, name, token)
+
+    # -- snapshot-streamed replica migration ---------------------------------
+
+    def _h_migrate(self, body: dict, _parts) -> dict:
+        """POST /partitions/migrate {partition_id, to_node[, from_node]}
+        — move one replica via raft-learner catch-up (chunked engine
+        snapshot when behind the WAL horizon), promote to voter, retire
+        the source. The serving leader never stops; routers retry the
+        brief swap window, so clients see zero failed queries."""
+        pid = int(body["partition_id"])
+        to_node = int(body["to_node"])
+        located = self._find_partition(pid)
+        if located is None:
+            raise RpcError(404, f"partition {pid} not found")
+        _key, _sp, p = located
+        replicas = [int(r) for r in p["replicas"]]
+        if "from_node" in body:
+            from_node = int(body["from_node"])
+        else:
+            # default: prefer moving a follower so leadership stays put
+            others = [r for r in replicas if r != int(p["leader"])]
+            from_node = others[0] if others else int(p["leader"])
+        if from_node not in replicas:
+            raise RpcError(400, f"node {from_node} holds no replica of "
+                                f"partition {pid}")
+        if to_node in replicas:
+            raise RpcError(400, f"node {to_node} already holds a "
+                                f"replica of partition {pid}")
+        if not any(s.node_id == to_node for s in self._alive_servers()):
+            raise RpcError(404, f"node {to_node} not alive")
+        timeout_s = float(body.get("timeout_s", 600.0))
+        job = self._new_elastic_job("migrate", {
+            "partition_id": pid, "from_node": from_node,
+            "to_node": to_node, "lag": None})
+
+        def worker():
+            try:
+                self._migrate_one(
+                    pid, from_node, to_node,
+                    lambda **kw: self._ejob_update(job, **kw),
+                    timeout_s=timeout_s)
+                self._m_migrations.inc("done")
+                self._ejob_finish(job, None)
+            except RpcError as e:
+                self._m_migrations.inc("error")
+                self._ejob_finish(job, e.msg)
+            except Exception as e:
+                _log.error("migrate job %s failed: %s: %s",
+                           job["job_id"], type(e).__name__, e)
+                self._m_migrations.inc("error")
+                self._ejob_finish(job, f"{type(e).__name__}: {e}")
+
+        threading.Thread(target=worker, daemon=True,
+                         name=f"elastic-{job['job_id']}").start()
+        return {"job_id": job["job_id"], "status": "running",
+                "partition_id": pid, "from_node": from_node,
+                "to_node": to_node}
+
+    def _migrate_one(self, pid: int, from_node: int, to_node: int,
+                     upd, timeout_s: float = 600.0) -> None:
+        """Move one replica of `pid` from `from_node` to `to_node`:
+
+        1. create on the target as a raft LEARNER — it receives appends
+           and (when behind the WAL compaction horizon) the chunked
+           engine snapshot stream, but never votes or counts toward
+           quorum, so a slow catch-up cannot stall serving;
+        2. poll the leader's per-peer lag until the learner caught up;
+        3. swap: fence at a bumped term, verify the target's log covers
+           the leader's last entry (every committed write lives on the
+           leader, so this proves no acked write can be lost), decree
+           the new membership with the target as a voter and the source
+           removed; writes that raced the lag check re-appoint the old
+           leader for another catch-up round;
+        4. retire the source replica.
+
+        Raises RpcError on failure; the partition keeps serving from
+        its original members in every failure mode (the learner is
+        outside the quorum until step 3's decree)."""
+        located = self._find_partition(pid)
+        if located is None:
+            raise RpcError(404, f"partition {pid} not found")
+        key, sp, p = located
+        replicas = sorted(int(r) for r in p["replicas"])
+        if from_node not in replicas:
+            raise RpcError(400, f"node {from_node} holds no replica of "
+                                f"partition {pid}")
+        if to_node in replicas:
+            raise RpcError(400, f"node {to_node} already holds a "
+                                f"replica of partition {pid}")
+        servers = {s.node_id: s for s in self._alive_servers()}
+        target = servers.get(to_node)
+        if target is None:
+            raise RpcError(404, f"node {to_node} not alive")
+        leader = int(p["leader"])
+        leader_srv = servers.get(leader)
+        if leader_srv is None:
+            raise RpcError(503, f"partition {pid} is leaderless")
+
+        upd(phase="prepare", partition_id=pid, from_node=from_node,
+            to_node=to_node)
+        learners = sorted(set(int(x) for x in p.get("learners", []))
+                          | {to_node})
+        part = dict(p)
+        part["learners"] = learners
+        try:
+            rpc.call(target.rpc_addr, "POST", "/ps/partition/create",
+                     {"partition": part, "schema": sp["schema"]})
+        except RpcError as e:
+            if e.code != 409:  # already hosted (a resumed job): go on
+                raise
+        with self._reconfig_lock:
+            term1 = int(p.get("term", 1)) + 1
+            rpc.call(leader_srv.rpc_addr, "POST", "/ps/raft/lead",
+                     {"pid": pid, "term": term1, "members": replicas,
+                      "learners": learners})
+            for r in replicas + [to_node]:
+                if r == leader:
+                    continue
+                srv = servers.get(r)
+                if srv is None:
+                    continue
+                try:
+                    rpc.call(srv.rpc_addr, "POST", "/ps/raft/members",
+                             {"pid": pid, "term": term1,
+                              "members": replicas, "leader": leader,
+                              "learners": learners})
+                except RpcError:
+                    pass  # a missed follower converges on the swap decree
+            p["term"] = term1
+            p["learners"] = learners
+            self.store.put(key, sp)
+
+        upd(phase="catchup")
+        deadline = time.monotonic() + timeout_s
+        misses = 0
+        while True:
+            try:
+                st = rpc.call(leader_srv.rpc_addr, "GET",
+                              f"/ps/raft/state/{pid}")
+                misses = 0
+            except RpcError:
+                misses += 1
+                if misses >= 10:
+                    raise
+                time.sleep(0.3)
+                continue
+            info = (st.get("peers") or {}).get(str(to_node)) or {}
+            lag = info.get("lag")
+            upd(lag=lag)
+            if lag == 0:
+                break
+            if time.monotonic() > deadline:
+                raise RpcError(503, f"learner {to_node} catch-up timed "
+                                    f"out (lag={lag})")
+            time.sleep(0.2)
+
+        upd(phase="swap")
+        new_members = sorted(set(replicas) - {from_node} | {to_node})
+        new_leader = leader if leader != from_node else to_node
+        term = int(p["term"])
+        for _attempt in range(20):
+            term += 1
+            states = {}
+            for r in sorted(set(replicas) | {to_node}):
+                srv = servers.get(r)
+                if srv is None:
+                    continue
+                try:
+                    states[r] = rpc.call(srv.rpc_addr, "POST",
+                                         "/ps/raft/fence",
+                                         {"pid": pid, "term": term})
+                except RpcError:
+                    continue
+            if leader not in states or to_node not in states:
+                raise RpcError(503,
+                               f"fence failed for partition {pid}")
+            gap = (int(states[leader]["last_index"])
+                   - int(states[to_node]["last_index"]))
+            if gap <= 0:
+                break
+            # writes raced the lag check: resume the old leadership so
+            # replication continues, then fence again next round
+            rpc.call(leader_srv.rpc_addr, "POST", "/ps/raft/lead",
+                     {"pid": pid, "term": term, "members": replicas,
+                      "learners": learners})
+            upd(lag=gap)
+            time.sleep(0.2)
+        else:
+            raise RpcError(503, f"learner {to_node} kept lagging "
+                                f"through the swap window")
+        with self._reconfig_lock:
+            rpc.call(servers[new_leader].rpc_addr, "POST",
+                     "/ps/raft/lead",
+                     {"pid": pid, "term": term, "members": new_members,
+                      "learners": []})
+            for r in new_members:
+                if r == new_leader:
+                    continue
+                srv = servers.get(r)
+                if srv is None:
+                    continue
+                try:
+                    rpc.call(srv.rpc_addr, "POST", "/ps/raft/members",
+                             {"pid": pid, "term": term,
+                              "members": new_members,
+                              "leader": new_leader, "learners": []})
+                except RpcError:
+                    pass
+            p["replicas"] = new_members
+            p["leader"] = new_leader
+            p["term"] = term
+            p["learners"] = []
+            self.store.put(key, sp)
+            if pid not in target.partition_ids:
+                target.partition_ids.append(pid)
+                self.store.put(f"{PREFIX_SERVER}{to_node}",
+                               target.to_dict())
+            src = servers.get(from_node)
+            if src is not None and pid in src.partition_ids:
+                src.partition_ids.remove(pid)
+                self.store.put(f"{PREFIX_SERVER}{from_node}",
+                               src.to_dict())
+
+        # retire the source replica (best-effort: a dead source's
+        # on-disk copy is inert — it is no longer in the membership)
+        upd(phase="retire_source")
+        src = servers.get(from_node)
+        if src is not None:
+            try:
+                rpc.call(src.rpc_addr, "POST", "/ps/partition/delete",
+                         {"partition_id": pid})
+            except RpcError:
+                pass
+        upd(phase="done", lag=0)
+
+    # -- load-aware rebalancing + drain --------------------------------------
+
+    def _h_plan(self, _body, _parts) -> dict:
+        """GET /cluster/plan — the load-aware plan, read-only: imbalance
+        score, suggested replica moves, suggested splits. Heartbeat
+        stats live on the leader; followers forward."""
+        fwd = self._leader_get("/cluster/plan")
+        if fwd is not None:
+            return fwd
+        return elastic.compute_plan(self._load_spaces(),
+                                    self._alive_servers(),
+                                    self._node_stats)
+
+    def _h_rebalance(self, body: dict, _parts) -> dict:
+        """POST /cluster/rebalance {apply} — compute the plan; with
+        apply=true, execute its moves as one sequential job. Splits are
+        returned as suggestions for the operator (POST
+        /partitions/split) and never auto-run: they rewrite the routing
+        map."""
+        body = body or {}
+        plan = elastic.compute_plan(
+            self._load_spaces(), self._alive_servers(),
+            self._node_stats,
+            max_moves=int(body.get("max_moves", 4)))
+        if not bool(body.get("apply")) or not plan["moves"]:
+            return {**plan, "applied": False}
+        job = self._new_elastic_job(
+            "rebalance", {"imbalance": plan["imbalance"],
+                          "total": len(plan["moves"])})
+        with self._elastic_jobs_lock:
+            job["steps"] = [{**m, "status": "pending", "error": None}
+                            for m in plan["moves"]]
+        threading.Thread(target=self._run_moves_job, args=(job,),
+                         daemon=True,
+                         name=f"elastic-{job['job_id']}").start()
+        return {**plan, "applied": True, "job_id": job["job_id"]}
+
+    def _h_drain(self, body: dict, _parts) -> dict:
+        """POST /cluster/drain {node_id, apply} — plan (default) or run
+        migrating every replica off a PS so it can be retired. 409 when
+        any partition has nowhere to go without co-locating."""
+        node_id = int(body["node_id"])
+        servers = {s.node_id: s for s in self._alive_servers()}
+        if node_id not in servers:
+            raise RpcError(404, f"node {node_id} not registered")
+        moves = self._drain_plan(node_id, servers)
+        if not bool(body.get("apply")):
+            return {"node_id": node_id, "moves": moves,
+                    "applied": False}
+        job = self._new_elastic_job("drain", {"node_id": node_id,
+                                              "total": len(moves)})
+        with self._elastic_jobs_lock:
+            job["steps"] = [{**m, "status": "pending", "error": None}
+                            for m in moves]
+        threading.Thread(target=self._run_moves_job, args=(job,),
+                         daemon=True,
+                         name=f"elastic-{job['job_id']}").start()
+        return {"node_id": node_id, "job_id": job["job_id"],
+                "status": "running", "moves": moves}
+
+    def _drain_plan(self, node_id: int, servers: dict) -> list[dict]:
+        moves = []
+        loads = elastic.node_loads(list(servers.values()),
+                                   self._node_stats)
+        # simulate against a moving load map so successive picks spread
+        # over the targets instead of dogpiling the single coldest node
+        sim = dict(loads)
+        for _key, sp in sorted(self.store.prefix(PREFIX_SPACE).items()):
+            for p in sp["partitions"]:
+                if node_id not in p["replicas"]:
+                    continue
+                cands = [n for n in servers
+                         if n != node_id and n not in p["replicas"]]
+                if not cands:
+                    raise RpcError(
+                        409,
+                        f"partition {p['id']}: no alive node outside "
+                        f"its replica set — draining node {node_id} "
+                        f"would co-locate replicas")
+                st = self._node_stats.get(node_id, {}).get(
+                    str(p["id"]))
+                w = float((st or {}).get("size_bytes", 0) or 0)
+                tgt = min(cands, key=lambda n: (sim.get(n, 0.0), n))
+                sim[tgt] = sim.get(tgt, 0.0) + w
+                sim[node_id] = sim.get(node_id, 0.0) - w
+                moves.append({"partition_id": int(p["id"]),
+                              "from_node": node_id, "to_node": tgt,
+                              "reason": "drain"})
+        return moves
+
+    def _run_moves_job(self, job: dict) -> None:
+        """Sequential executor for a list of migration steps (drain and
+        rebalance-apply share it): one partition in flight at a time,
+        so at most one extra copy of any partition's data exists."""
+        failed = 0
+
+        def upd(**kw):
+            with self._elastic_jobs_lock:
+                if "phase" in kw:
+                    job["phase"] = kw.pop("phase")
+                job["detail"].update(kw)
+                job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+
+        for step in list(job["steps"]):
+            with self._elastic_jobs_lock:
+                step["status"] = "running"
+                job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+            try:
+                self._migrate_one(step["partition_id"],
+                                  step["from_node"], step["to_node"],
+                                  upd)
+                self._m_migrations.inc("done")
+                with self._elastic_jobs_lock:
+                    step["status"] = "done"
+            except RpcError as e:
+                failed += 1
+                self._m_migrations.inc("error")
+                with self._elastic_jobs_lock:
+                    step["status"] = "error"
+                    step["error"] = e.msg
+            except Exception as e:
+                failed += 1
+                self._m_migrations.inc("error")
+                _log.error("elastic job %s step p%s failed: %s: %s",
+                           job["job_id"], step["partition_id"],
+                           type(e).__name__, e)
+                with self._elastic_jobs_lock:
+                    step["status"] = "error"
+                    step["error"] = f"{type(e).__name__}: {e}"
+        self._ejob_finish(
+            job, f"{failed} move(s) failed" if failed else None)
+
+    def _auto_rebalance_loop(self) -> None:
+        """Opt-in closed loop (auto_rebalance=True, default off):
+        periodically apply the planner's moves when the cluster is
+        imbalanced and no elastic job is already in flight. Splits stay
+        operator-driven even here."""
+        while not self._stop.is_set():
+            self._stop.wait(self.rebalance_interval)
+            if self._stop.is_set() or not self.is_leader:
+                continue
+            try:
+                with self._elastic_jobs_lock:
+                    busy = any(j["status"] == "running"
+                               for j in self._elastic_jobs.values())
+                if busy:
+                    continue
+                plan = elastic.compute_plan(self._load_spaces(),
+                                            self._alive_servers(),
+                                            self._node_stats)
+                if not plan["moves"]:
+                    continue
+                job = self._new_elastic_job(
+                    "rebalance", {"imbalance": plan["imbalance"],
+                                  "total": len(plan["moves"]),
+                                  "auto": True})
+                with self._elastic_jobs_lock:
+                    job["steps"] = [{**m, "status": "pending",
+                                     "error": None}
+                                    for m in plan["moves"]]
+                self._run_moves_job(job)
+            except Exception as e:
+                _log.error("auto-rebalance pass failed: %s: %s",
+                           type(e).__name__, e)
+
     # -- space create (reference: services/space_service.go:59) --------------
 
     def _create_space(self, db: str, body: dict) -> dict:
@@ -1925,32 +2677,18 @@ class MasterServer:
     def _place_replicas(self, space: Space, servers) -> list[int]:
         """Replica placement: least-loaded with anti-affinity by the
         space's strategy (reference: config.go:389 none/host/rack/zone;
-        space_service.go:1272 placement). Falls back to allowing label
-        collisions when the topology is too small, like the reference.
-        Load spreads across successive placements because the caller
-        appends to partition_ids between calls."""
-        label = space.anti_affinity
-        chosen: list[int] = []
-        used_labels: set[str] = set()
-        pool = sorted(servers,
-                      key=lambda s: (len(s.partition_ids), s.node_id))
-        for _ in range(space.replica_num):
-            pick = None
-            if label != "none":
-                pick = next(
-                    (s for s in pool
-                     if s.node_id not in chosen
-                     and s.labels.get(label, f"~{s.node_id}")
-                     not in used_labels),
-                    None,
-                )
-            if pick is None:
-                pick = next(
-                    (s for s in pool if s.node_id not in chosen), None
-                )
-            chosen.append(pick.node_id)
-            used_labels.add(pick.labels.get(label, f"~{pick.node_id}"))
-        return chosen
+        space_service.go:1272 placement). Delegates to the pure planner
+        (elastic.place_replicas): strict no-co-location by node — the
+        old inline version could either co-locate two replicas on one
+        PS or crash, depending on pool order — plus least-loaded-by-
+        reported-bytes preference and a deterministic tie-break. Load
+        spreads across successive placements because the caller appends
+        to partition_ids between calls."""
+        try:
+            return elastic.place_replicas(space, list(servers),
+                                          self._node_stats)
+        except ValueError as e:
+            raise RpcError(400, str(e)) from None
 
     def _create_partition_group(self, space: Space, servers, group,
                                 slots: list[int] | None = None) -> None:

@@ -22,13 +22,18 @@ import threading
 import time
 
 import numpy as np
+from collections import deque
 from typing import Any
 
 from vearch_tpu.engine.engine import Engine, SearchRequest
 from vearch_tpu.engine.types import DataType, TableSchema
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Partition
-from vearch_tpu.cluster.metrics import SIZE_BUCKETS, register_tracer_metrics
+from vearch_tpu.cluster.metrics import (
+    SIZE_BUCKETS,
+    internal_error,
+    register_tracer_metrics,
+)
 from vearch_tpu.cluster.raft import RaftNode
 from vearch_tpu.cluster.rpc import (
     ERR_REQUEST_KILLED,
@@ -44,6 +49,15 @@ _log = log.get("ps")
 # lagging follower catches up by replay instead of full snapshot
 # (reference: raft_truncate_count)
 WAL_KEEP_ENTRIES = 10_000
+
+# split copy batch size: bounds both the per-forward RPC payload and
+# how long the mirror queue waits between drain opportunities
+SPLIT_COPY_BATCH = 256
+
+
+class _SplitAborted(Exception):
+    """Internal control flow for the split worker: the job must end in
+    status=error (master garbage-collects the children and may retry)."""
 
 
 def _profile_from_timing(timing: dict) -> dict:
@@ -119,6 +133,8 @@ class PSServer:
         "replication_errors": "_stats_lock",
         "slow_routed": "_stats_lock",
         "_search_ewma": "_stats_lock",
+        "_op_counts": "_stats_lock",
+        "_split_jobs": "_split_lock",
     }
 
     def __init__(
@@ -197,6 +213,17 @@ class PSServer:
         self._backup_jobs: dict[str, dict] = {}
         self._backup_jobs_lock = lockcheck.make_lock(
             "ps._backup_jobs_lock")
+        # online partition-split jobs (elastic data plane): pid -> job
+        # dict owned by one named worker thread; write handlers enqueue
+        # mirror entries under the same lock so the lock never nests
+        # with the partition registry's
+        self._split_jobs: dict[int, dict] = {}
+        self._split_lock = lockcheck.make_lock("ps._split_lock")
+        self._split_cv = threading.Condition(self._split_lock)
+        # per-partition cumulative search/write counters riding the
+        # heartbeat — the master's rebalance planner scores hotness
+        # from the deltas
+        self._op_counts: dict[int, dict[str, int]] = {}
         self.slow_request_ms = 0
         self.killed_requests = 0
         # per-request deadline default (ms); a search may override via
@@ -276,6 +303,13 @@ class PSServer:
         s.route("GET", "/ps/requests", self._h_requests)
         s.route("GET", "/ps/jobs", self._h_jobs)
         s.route("GET", "/debug/slowlog", self._h_slowlog)
+        # online partition split (elastic data plane): the master drives
+        # start -> poll progress -> finish(commit|abort) on the parent's
+        # leader; the double-write mirror lives here
+        s.route("POST", "/ps/partition/split/start", self._h_split_start)
+        s.route("GET", "/ps/partition/split/progress",
+                self._h_split_progress)
+        s.route("POST", "/ps/partition/split/finish", self._h_split_finish)
         # raft transport (reference: raftstore/server.go heartbeat +
         # replicate ports; here routes on the one RPC server)
         s.route("POST", "/ps/raft/append", self._h_raft_append)
@@ -366,6 +400,42 @@ class PSServer:
                          "docs processed / total for the current or "
                          "last index build",
                          ("partition",), _build_progress)
+
+        # split-job progress gauges: one series per hosted partition
+        # with 0.0 when idle (same cardinality discipline as the build
+        # gauge — a split starting mid-soak must not mint a new series)
+        def _split_progress():
+            with self._split_lock:
+                jobs = {pid: (job.get("docs_done", 0),
+                              job.get("docs_total", 0),
+                              job.get("status"))
+                        for pid, job in self._split_jobs.items()}
+            out = {}
+            for pid in list(self.engines):
+                done, total, status = jobs.get(pid, (0, 0, None))
+                if status in ("done", "error"):
+                    out[(str(pid),)] = 1.0
+                else:
+                    out[(str(pid),)] = min(
+                        float(done) / max(int(total or 0), 1), 1.0)
+            return out
+
+        def _split_queue():
+            with self._split_lock:
+                depth = {pid: len(job["_queue"])
+                         for pid, job in self._split_jobs.items()
+                         if job.get("status") == "running"}
+            return {(str(pid),): float(depth.get(pid, 0))
+                    for pid in list(self.engines)}
+
+        m.callback_gauge("vearch_ps_split_progress",
+                         "copied docs / total for the current or last "
+                         "partition split on this node",
+                         ("partition",), _split_progress)
+        m.callback_gauge("vearch_ps_split_mirror_queue",
+                         "pending double-write mirror entries for the "
+                         "active partition split",
+                         ("partition",), _split_queue)
 
         # raft replication observability (tentpole: VERDICT weak #2 was
         # undiagnosable because raft exposed no lag/latency/election
@@ -551,10 +621,16 @@ class PSServer:
         """Per-partition stats riding the heartbeat so the master can
         export cluster-level doc/size gauges (reference: master scrapes
         partition stats into monitor_service.go:51-73 gauges)."""
+        with self._split_lock:
+            split_status = {pid: job.get("status")
+                            for pid, job in self._split_jobs.items()}
+        with self._stats_lock:
+            ops = {pid: dict(c) for pid, c in self._op_counts.items()}
         out = {}
         for pid, eng in list(self.engines.items()):
             try:
                 job = eng.build_job
+                part = self.partitions.get(pid)
                 out[str(pid)] = {
                     "doc_count": eng.doc_count,
                     "size_bytes": eng.memory_usage_bytes(),
@@ -562,6 +638,19 @@ class PSServer:
                     "leader": (
                         bool(self.raft_nodes[pid].state().get("is_leader"))
                         if pid in self.raft_nodes else True
+                    ),
+                    # cumulative op counters: the master's rebalance
+                    # planner derives hotness from scrape-to-scrape
+                    # deltas of these
+                    "searches_total": ops.get(pid, {}).get("searches", 0),
+                    "writes_total": ops.get(pid, {}).get("writes", 0),
+                    # elastic-job state rides the heartbeat so
+                    # /cluster/health rolls up splits and learner
+                    # catch-ups without polling every PS
+                    "split_status": split_status.get(pid),
+                    "learner": bool(
+                        part is not None
+                        and self.node_id in getattr(part, "learners", [])
                     ),
                     # index-build job state rides the heartbeat so the
                     # master's /cluster/health can roll up in-flight and
@@ -724,6 +813,7 @@ class PSServer:
             install_fn=lambda data, idx, _pid=pid: self._install_snapshot(
                 _pid, data, idx),
             observer=self._raft_observer(pid),
+            learners=list(getattr(part, "learners", []) or []),
         )
         node.wal.observer = self._wal_observer(pid)
         return node
@@ -828,19 +918,23 @@ class PSServer:
     def _h_raft_lead(self, body: dict, _parts) -> dict:
         pid = int(body["pid"])
         node = self._node(pid)
-        out = node.become_leader(int(body["term"]), body["members"])
+        out = node.become_leader(int(body["term"]), body["members"],
+                                 learners=body.get("learners"))
         self._update_partition_meta(pid, leader=self.node_id,
                                     term=int(body["term"]),
-                                    replicas=body["members"])
+                                    replicas=body["members"],
+                                    learners=body.get("learners"))
         return out
 
     def _h_raft_members(self, body: dict, _parts) -> dict:
         pid = int(body["pid"])
         node = self._node(pid)
-        out = node.set_members(int(body["term"]), body["members"])
+        out = node.set_members(int(body["term"]), body["members"],
+                               learners=body.get("learners"))
         self._update_partition_meta(pid, term=int(body["term"]),
                                     replicas=body["members"],
-                                    leader=body.get("leader"))
+                                    leader=body.get("leader"),
+                                    learners=body.get("learners"))
         return out
 
     def _h_raft_snapshot(self, body: dict, _parts) -> dict:
@@ -852,7 +946,7 @@ class PSServer:
         return {str(pid): n.state() for pid, n in self.raft_nodes.items()}
 
     def _update_partition_meta(self, pid: int, leader=None, term=None,
-                               replicas=None) -> None:
+                               replicas=None, learners=None) -> None:
         part = self.partitions.get(pid)
         if part is None:
             return
@@ -862,6 +956,8 @@ class PSServer:
             part.term = term
         if replicas is not None:
             part.replicas = list(replicas)
+        if learners is not None:
+            part.learners = [int(x) for x in learners]
         self._persist_partition_meta(part)
 
     def _persist_partition_meta(self, part: Partition) -> None:
@@ -936,7 +1032,10 @@ class PSServer:
         while not self._stop.is_set():
             time.sleep(self.raft_tick)
             for node in list(self.raft_nodes.values()):
-                if node.is_leader and len(node.members) > 1:
+                # also tick single-voter groups that carry learners:
+                # the migration catch-up stream rides the tick
+                if node.is_leader and (len(node.members) > 1
+                                       or node.learners):
                     node.tick()
 
     # -- snapshot transfer (reference: gammacb/snapshot.go:26 streams the
@@ -1042,6 +1141,10 @@ class PSServer:
 
     def _h_delete_partition(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])
+        # an active split ends here: for a committed split this IS the
+        # normal finalization (the master deletes the parent last); the
+        # teardown drains the mirror queue while the engine still lives
+        self._split_teardown(pid)
         with self._lock:
             node = self.raft_nodes.pop(pid, None)
             if node is not None:
@@ -1129,12 +1232,19 @@ class PSServer:
         if isinstance(keys, dict) and "_rejected" in keys:
             raise RpcError(400, keys["_rejected"])
         self._write_docs_total.inc(str(pid), "upsert", by=float(len(docs)))
+        self._count_op(pid, "writes")
+        # double-write mirror for an active split: in the sync window
+        # this blocks until the children hold the write, so the ack the
+        # client sees is as durable post-cutover as pre-cutover
+        self._split_mirror(pid, "upsert",
+                           [str(d["_id"]) for d in docs])
         # propose() returns only after the entry applied locally, so
         # this applied index covers the write just acknowledged — the
         # router bumps its version map from it, which is exactly what
         # keeps read-your-writes through the result cache
         out = {"keys": keys, "count": len(keys),
-               "apply_version": int(node.applied)}
+               "apply_version": int(node.applied),
+               "map_version": self._map_version(pid)}
         if profile:
             out["profile"] = _write_profile_from_timing(timing or {})
         return out
@@ -1178,8 +1288,12 @@ class PSServer:
                     self._replay_write_spans(span, timing, pid)
             self._write_docs_total.inc(str(pid), "delete",
                                        by=float(deleted or 0))
+            self._count_op(pid, "writes")
+            self._split_mirror(pid, "delete",
+                               [str(k) for k in body["keys"]])
             out = {"deleted": deleted,
-                   "apply_version": int(node.applied)}
+                   "apply_version": int(node.applied),
+                   "map_version": self._map_version(pid)}
             if profile:
                 out["profile"] = _write_profile_from_timing(timing or {})
             return out
@@ -1201,10 +1315,13 @@ class PSServer:
                 break
             keys = [d["_id"] for d in docs]
             deleted += node.propose([{"type": "delete", "keys": keys}])[0]
+            self._split_mirror(pid, "delete", [str(k) for k in keys])
             if len(docs) < want:
                 break
         self._write_docs_total.inc(str(pid), "delete", by=float(deleted))
-        return {"deleted": deleted, "apply_version": int(node.applied)}
+        self._count_op(pid, "writes")
+        return {"deleted": deleted, "apply_version": int(node.applied),
+                "map_version": self._map_version(pid)}
 
     def _h_get(self, body: dict, _parts) -> dict:
         eng = self._engine(body["partition_id"])
@@ -1306,6 +1423,7 @@ class PSServer:
             for name, v in body["vectors"].items()
         }
         pid = int(body["partition_id"])
+        self._count_op(pid, "searches")
         # slow-channel routing: partitions with a slow recent history go
         # through the small slow gate; everyone else uses the fast gate
         slow = bool(
@@ -1375,6 +1493,9 @@ class PSServer:
                 # every response carries the partition's apply version
                 # — the router's entry-validation signal
                 out["apply_version"] = applied
+                # ... and the partition-map epoch, so a router holding a
+                # stale map learns of a split cutover from any response
+                out["map_version"] = self._map_version(pid)
                 span.set_tag("cache", cache_status)
                 if timing is not None:
                     timing["gate_wait_ms"] = gate_wait_ms
@@ -1644,9 +1765,10 @@ class PSServer:
                     )
 
     def _h_jobs(self, _body, _parts) -> dict:
-        """Index-build job registry: one entry per partition that has
-        run (or is running) a build since process start. Internal keys
-        (the `_phase_spans` replay rows) are stripped."""
+        """Background-job registry: index builds, partition splits, and
+        synthesized learner-catchup entries (one per partition this node
+        leads that is streaming a raft learner up to date). Internal
+        keys (`_phase_spans`, the split mirror queue) are stripped."""
         jobs = []
         for pid, eng in sorted(self.engines.items()):
             job = eng.build_job
@@ -1656,11 +1778,388 @@ class PSServer:
                 "partition_id": pid,
                 **{k: v for k, v in job.items() if not k.startswith("_")},
             })
+        with self._split_lock:
+            for pid in sorted(self._split_jobs):
+                jobs.append(self._split_public(self._split_jobs[pid]))
+        # learner catch-up is raft state, not a registry entry — shape
+        # it like a job so one /ps/jobs poll shows every phase of a
+        # migration (reference: the master's job rollup reads this)
+        for pid, node in sorted(self.raft_nodes.items()):
+            if not node.is_leader or not node.learners:
+                continue
+            st = node.state()
+            for learner in node.learners:
+                info = st["peers"].get(str(learner))
+                if info is None:
+                    continue
+                jobs.append({
+                    "op": "learner_catchup", "partition_id": pid,
+                    "status": "running" if info["lag"] else "caught_up",
+                    "learner": learner, "lag": info["lag"],
+                    "next": info["next"],
+                })
         return {"jobs": jobs}
 
     def _h_slowlog(self, _body, _parts) -> dict:
         return {"threshold_ms": self.slowlog.threshold_ms,
                 "entries": self.slowlog.entries()}
+
+    # -- online partition split (elastic data plane) -------------------------
+    #
+    # The master drives the lifecycle against the parent's leader:
+    #   start -> poll progress until phase=cutover_ready -> flip the
+    #   space's partition map (metastore) -> finish{commit} -> delete
+    #   the parent everywhere (which finalizes the job here).
+    #
+    # Correctness contract: from the moment the job enters the sync
+    # window, every write the parent acknowledges blocks until the
+    # children hold it too (double-write), so cutover_ready means the
+    # children are a superset-in-time of the parent. The parent KEEPS
+    # sync-mirroring after commit until it is deleted — a router on a
+    # stale map may still write through it during the flip window.
+
+    def _count_op(self, pid: int, kind: str) -> None:
+        with self._stats_lock:
+            c = self._op_counts.setdefault(pid, {"searches": 0,
+                                                 "writes": 0})
+            c[kind] = c.get(kind, 0) + 1
+
+    def _map_version(self, pid: int) -> int:
+        part = self.partitions.get(int(pid))
+        return int(getattr(part, "map_version", 0) or 0) \
+            if part is not None else 0
+
+    def _split_public(self, job: dict) -> dict:
+        """Operator view of a split job: internal keys stripped, queue
+        depth surfaced. Callers hold _split_lock."""
+        out = {k: v for k, v in job.items() if not k.startswith("_")}
+        out["queue"] = len(job["_queue"])
+        return out
+
+    def _h_split_start(self, body: dict, _parts) -> dict:
+        pid = int(body["partition_id"])
+        self._engine(pid)
+        node = self._node(pid)
+        if not node.is_leader:
+            raise RpcError(421, f"partition {pid}: split must start on "
+                                f"the leader")
+        children = [
+            {"id": int(c["id"]), "slot_lo": int(c["slot_lo"]),
+             "slot_hi": int(c["slot_hi"]), "leader": int(c["leader"])}
+            for c in body["children"]
+        ]
+        if len(children) != 2:
+            raise RpcError(400, "split takes exactly two children")
+        with self._split_lock:
+            existing = self._split_jobs.get(pid)
+            if existing is not None and existing["status"] == "running":
+                raise RpcError(
+                    409, f"split already running for partition {pid}")
+            job = {
+                "op": "split", "status": "running", "phase": "copy",
+                "partition_id": pid, "children": children,
+                "docs_total": 0, "docs_done": 0, "mirrored": 0,
+                "started": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+                "updated": time.time(),  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+                "phases_ms": {}, "error": None,
+                "_queue": deque(), "_sync": False, "_finish": None,
+                "_teardown": False,
+            }
+            self._split_jobs[pid] = job
+        threading.Thread(target=self._run_split, args=(pid, job),
+                         daemon=True, name=f"split-p{pid}").start()
+        return {"partition_id": pid, "status": "running",
+                "children": [c["id"] for c in children]}
+
+    def _h_split_progress(self, body, _parts) -> dict:
+        q = ((body or {}).get("_query") or {})
+        pid = int(q.get("partition_id")
+                  or (body or {}).get("partition_id"))
+        with self._split_lock:
+            job = self._split_jobs.get(pid)
+            if job is None:
+                raise RpcError(404, f"no split job for partition {pid}")
+            return self._split_public(job)
+
+    def _h_split_finish(self, body: dict, _parts) -> dict:
+        pid = int(body["partition_id"])
+        commit = bool(body.get("commit", True))
+        with self._split_lock:
+            job = self._split_jobs.get(pid)
+            if job is None:
+                raise RpcError(404, f"no split job for partition {pid}")
+            if job["status"] == "running" and job["_finish"] is None:
+                if commit and job["phase"] != "cutover_ready":
+                    raise RpcError(
+                        409, f"split for partition {pid} is not "
+                             f"cutover-ready (phase {job['phase']})")
+                job["_finish"] = "commit" if commit else "abort"
+                self._split_cv.notify_all()
+        # wait for the worker to acknowledge: commit -> phase
+        # "committed" (mirror stays open until the parent is deleted);
+        # abort -> terminal status
+        deadline = time.monotonic() + 30.0  # bounded RPC, not a job clock
+        while time.monotonic() < deadline:
+            with self._split_lock:
+                if ((commit and job["phase"] == "committed")
+                        or job["status"] != "running"):
+                    return self._split_public(job)
+            time.sleep(0.02)
+        with self._split_lock:
+            return self._split_public(job)
+
+    def _split_teardown(self, pid: int) -> None:
+        """Called by partition delete BEFORE the engine goes away: tell
+        the worker the parent is being removed and wait for it to drain
+        the mirror queue (acked writes must reach the children while
+        the parent engine can still be read)."""
+        with self._split_lock:
+            job = self._split_jobs.get(pid)
+            if job is None or job["status"] != "running":
+                return
+            job["_teardown"] = True
+            self._split_cv.notify_all()
+        deadline = time.monotonic() + 15.0  # bounded wait, not a job clock
+        while time.monotonic() < deadline:
+            with self._split_lock:
+                if job["status"] != "running":
+                    return
+            time.sleep(0.02)
+
+    def _split_mirror(self, pid: int, kind: str,
+                      keys: list[str]) -> None:
+        """Hand a just-committed write's keys to the active split's
+        mirror worker. Pre-sync phases enqueue asynchronously (the
+        worker drains between copy batches); in the sync/cutover window
+        the caller blocks until the entry is forwarded, so the ack the
+        client sees implies the children hold the write."""
+        ev = None
+        with self._split_lock:
+            job = self._split_jobs.get(pid)
+            if job is None or job["status"] != "running":
+                return
+            if job["_sync"]:
+                ev = threading.Event()
+            job["_queue"].append((kind, list(keys), ev))
+            self._split_cv.notify_all()
+        if ev is not None and not ev.wait(timeout=30.0):
+            raise RpcError(
+                503, f"partition {pid}: split mirror stalled; write is "
+                     f"committed here but not yet on the children — retry")
+
+    def _run_split(self, pid: int, job: dict) -> None:
+        t0 = time.monotonic()
+        # wall anchor for span epochs; measurement stays monotonic
+        wall0 = time.time() - t0  # lint: allow[wall-clock] span epoch anchor, correlates with collector time
+        state = {"phase": "copy", "t": t0}
+
+        def enter_phase(name: str) -> None:
+            now = time.monotonic()
+            prev, t_prev = state["phase"], state["t"]
+            self.tracer.record(
+                f"split.{prev}",
+                start_us=int((wall0 + t_prev) * 1e6),
+                dur_us=int((now - t_prev) * 1e6),
+                tags={"partition": pid},
+            )
+            with self._split_lock:
+                job["phases_ms"][prev] = round((now - t_prev) * 1e3, 3)
+                if name is not None:
+                    job["phase"] = name
+                job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+            state["phase"], state["t"] = name, now
+
+        err: str | None = None
+        try:
+            eng = self._engine(pid)
+            node = self._node(pid)
+            # copy: one key snapshot, then batched re-read + forward.
+            # Keys only — the docs are re-read at forward time, so a
+            # doc updated after the snapshot forwards its LATEST state
+            keys = [d["_id"] for d in eng.query(
+                None, limit=max(eng.doc_count * 2, 1024),
+                include_fields=[], order_by_key=False)]
+            with self._split_lock:
+                job["docs_total"] = len(keys)
+            for i in range(0, len(keys), SPLIT_COPY_BATCH):
+                self._split_check_live(pid, job, node)
+                self._split_forward(pid, job, "copy",
+                                    keys[i:i + SPLIT_COPY_BATCH])
+                with self._split_lock:
+                    job["docs_done"] = min(i + SPLIT_COPY_BATCH,
+                                           len(keys))
+                    job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+                # drain concurrent-write mirror entries between batches
+                # so the queue stays bounded during a long copy; bounded
+                # by the backlog at entry — steady writers refill the
+                # queue as fast as we forward, so drain-to-empty would
+                # never return (only the sync window's per-write
+                # blocking can actually beat a sustained write rate)
+                self._split_drain(pid, job, node, block_s=0.0,
+                                  max_n=self._split_backlog(job))
+            enter_phase("catchup")
+            self._split_drain(pid, job, node, block_s=0.0,
+                              max_n=self._split_backlog(job))
+            # sync window opens: from here every acked write blocks on
+            # its own mirror forward; draining the backlog once more
+            # makes the children a superset-in-time of the parent
+            with self._split_lock:
+                job["_sync"] = True
+            enter_phase("sync")
+            self._split_drain(pid, job, node, block_s=0.0)
+            enter_phase("cutover_ready")
+            # hold the double-write open until the master commits (the
+            # parent's deletion finalizes the job) or aborts (children
+            # are garbage-collected by the master)
+            while True:
+                with self._split_lock:
+                    fin = job["_finish"]
+                    teardown = job["_teardown"]
+                if fin == "abort":
+                    raise _SplitAborted("aborted by master")
+                if fin == "commit" and state["phase"] == "cutover_ready":
+                    enter_phase("committed")
+                if teardown or self.engines.get(pid) is None:
+                    self._split_drain(pid, job, node, block_s=0.0)
+                    if state["phase"] == "committed":
+                        break  # normal finalization: parent retired
+                    raise _SplitAborted("parent partition removed")
+                if self._stop.is_set():
+                    raise _SplitAborted("partition server stopping")
+                if not node.is_leader:
+                    raise _SplitAborted("lost leadership")
+                self._split_drain(pid, job, node, block_s=0.25)
+        except _SplitAborted as e:
+            err = str(e)
+        except RpcError as e:
+            err = f"rpc {e.code}: {e}"
+        except Exception as e:  # job must land terminal, never wedge
+            internal_error("ps.split", e)
+            err = f"{type(e).__name__}: {e}"
+        finally:
+            enter_phase(None)  # close the last phase span/window
+            with self._split_lock:
+                job["status"] = "done" if err is None else "error"
+                job["error"] = err
+                job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+                # wake every writer still blocked on a sync mirror:
+                # their entries are committed on the parent; on abort
+                # the children are garbage-collected anyway
+                for _, _, ev in job["_queue"]:
+                    if ev is not None:
+                        ev.set()
+                job["_queue"].clear()
+                self._split_cv.notify_all()
+
+    def _split_check_live(self, pid: int, job: dict, node) -> None:
+        if self._stop.is_set():
+            raise _SplitAborted("partition server stopping")
+        if self.engines.get(pid) is None:
+            raise _SplitAborted("parent partition removed")
+        if not node.is_leader:
+            raise _SplitAborted("lost leadership")
+        with self._split_lock:
+            if job["_finish"] == "abort":
+                raise _SplitAborted("aborted by master")
+
+    def _split_backlog(self, job: dict) -> int:
+        with self._split_lock:
+            return len(job["_queue"])
+
+    def _split_drain(self, pid: int, job: dict, node,
+                     block_s: float, max_n: int | None = None) -> int:
+        """Forward queued mirror entries FIFO. With block_s > 0, waits
+        up to that long for a first entry (cutover idle loop); with 0,
+        drains whatever is queued and returns. `max_n` bounds the pass
+        (pre-sync callers: sustained writers refill as fast as we
+        forward, so drain-to-empty would not terminate — once _sync is
+        on, writers block per entry and the queue drains for real).
+        Entries are popped under _split_lock but forwarded outside it —
+        a slow child RPC must not block the write handlers enqueueing
+        behind us."""
+        n = 0
+        while max_n is None or n < max_n:
+            with self._split_lock:
+                if not job["_queue"] and n == 0 and block_s > 0:
+                    self._split_cv.wait(timeout=block_s)
+                if not job["_queue"]:
+                    return n
+                kind, keys, ev = job["_queue"].popleft()
+            try:
+                self._split_forward(pid, job, kind, keys)
+            finally:
+                if ev is not None:
+                    ev.set()
+            with self._split_lock:
+                job["mirrored"] += 1
+                job["updated"] = time.time()  # lint: allow[wall-clock] operator-facing job timestamp, ordering-only internally
+            n += 1
+
+    def _split_forward(self, pid: int, job: dict, kind: str,
+                       keys: list[str]) -> None:
+        """Route keys to their child by hash slot and forward. Upserts
+        RE-READ the parent engine at forward time rather than carrying
+        a payload from enqueue: the queue is FIFO per key, so the last
+        forward for any key ships the parent's current row (or, for a
+        key deleted meanwhile, skips it and lets the queued delete do
+        the removal) — re-reading makes reordering impossible by
+        construction."""
+        from vearch_tpu.cluster.hashing import key_slot
+
+        children = job["children"]
+
+        def child_of(key: str) -> dict:
+            slot = key_slot(str(key))
+            for c in children:
+                if c["slot_lo"] <= slot < c["slot_hi"]:
+                    return c
+            # the two ranges partition the parent's range; a slot
+            # outside both means the caller routed a foreign key here
+            raise RpcError(
+                500, f"split: key {key!r} (slot {slot}) outside both "
+                     f"child ranges of partition {pid}")
+
+        if kind == "delete":
+            by_child: dict[int, list[str]] = {}
+            for k in keys:
+                by_child.setdefault(child_of(k)["id"], []).append(k)
+            for c in children:
+                ks = by_child.get(c["id"])
+                if ks:
+                    self._split_rpc(c, "/ps/doc/delete",
+                                    {"partition_id": c["id"],
+                                     "keys": ks})
+            return
+        eng = self._engine(pid)
+        docs = eng.get(keys, None, vector_value=True)
+        by_pid: dict[int, list[dict]] = {}
+        for d in docs:
+            by_pid.setdefault(child_of(str(d["_id"]))["id"], []).append(d)
+        for c in children:
+            ds = by_pid.get(c["id"])
+            if ds:
+                self._split_rpc(c, "/ps/doc/upsert",
+                                {"partition_id": c["id"],
+                                 "documents": ds})
+
+    def _split_rpc(self, child: dict, path: str, body: dict) -> dict:
+        """Forward to a child's leader with bounded retries. 400/404
+        are structural (bad payload / child gone — the chaos case) and
+        fail fast so the master can garbage-collect; transient codes
+        retry with a fresh address in case the child's PS moved."""
+        last: RpcError | None = None
+        for attempt in range(3):
+            try:
+                addr = (self.addr if child["leader"] == self.node_id
+                        else self._peer_addr(child["leader"]))
+                return rpc.call(addr, "POST", path, body, timeout=30.0)
+            except RpcError as e:
+                last = e
+                if e.code in (400, 404):
+                    break
+                time.sleep(0.2 * (attempt + 1))
+        raise RpcError(
+            503, f"split forward to child {child['id']} failed: {last}")
 
     def _h_field_index(self, body: dict, _parts) -> dict:
         """Master fan-out target for online scalar field-index add/remove

@@ -168,6 +168,23 @@ class VersionedLRUCache:
                 self._data.popitem(last=False)
                 self.stats["eviction"] += 1
 
+    def evict_pids(self, pids) -> int:
+        """Drop every entry whose recorded version map touches any of
+        `pids` — the targeted invalidation for a partition-map remap
+        (split cutover / migration): only results computed against a
+        remapped partition are lost, the rest of the cache survives.
+        Counted under ``invalidated``; returns how many were dropped."""
+        doomed = set(pids)
+        if not doomed:
+            return 0
+        with self._lock:
+            stale = [k for k, (_, versions, _) in self._data.items()
+                     if doomed & set(versions)]
+            for k in stale:
+                del self._data[k]
+            self.stats["invalidated"] += len(stale)
+            return len(stale)
+
     def clear(self) -> int:
         with self._lock:
             n = len(self._data)

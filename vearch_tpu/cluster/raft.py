@@ -64,6 +64,7 @@ class RaftNode:
         "applied": "_lock",
         "is_leader": "_lock",
         "members": "_lock",
+        "learners": "_lock",
         "leader_hint": "_lock",
         "_last_leader_contact": "_lock",
         "_election_jitter": "_lock",
@@ -90,6 +91,7 @@ class RaftNode:
         election_timeout: float | None = None,
         route_prefix: str = "/ps/raft",
         observer: Callable[[str, dict], None] | None = None,
+        learners: list[int] | None = None,
     ):
         self.pid = pid
         self.node_id = node_id
@@ -102,6 +104,11 @@ class RaftNode:
         self.route_prefix = route_prefix
 
         self.members = list(members) if members else [node_id]
+        # non-voting replication targets (replica migration catch-up):
+        # they receive appends/snapshots and report lag in state(), but
+        # never count toward quorum() / _advance_commit and never
+        # campaign (election_tick's membership guard covers them)
+        self.learners = list(learners or [])
         self.is_leader = bool(is_leader)
         self.applied = 0  # set by recovery before serving
         self._apply_results: dict[int, Any] = {}
@@ -169,7 +176,16 @@ class RaftNode:
         return self.wal.commit_index
 
     def quorum(self) -> int:
+        # voters only: learners never change the commit arithmetic
         return len(self.members) // 2 + 1
+
+    def _peers(self) -> list[int]:
+        """Replication targets: voters + learners, minus self (commit
+        counting stays voters-only — see _advance_commit)."""
+        out = [m for m in self.members if m != self.node_id]
+        out += [l for l in self.learners
+                if l != self.node_id and l not in self.members]
+        return out
 
     def _observe(self, event: str, info: dict) -> None:
         if self._observer is None:
@@ -189,7 +205,7 @@ class RaftNode:
             last = self.wal.last_index
             return {
                 p: max(0, last - self._match.get(p, 0))
-                for p in self.members if p != self.node_id
+                for p in self._peers()
             }
 
     def heartbeat_age(self) -> float:
@@ -220,7 +236,7 @@ class RaftNode:
                         now - self._last_peer_ack.get(p, self._born), 3
                     ),
                 }
-                for p in self.members if p != self.node_id
+                for p in self._peers()
             } if self.is_leader else {}
             return {
                 "pid": self.pid,
@@ -234,6 +250,7 @@ class RaftNode:
                 "leader_hint": self.node_id if self.is_leader
                 else self.leader_hint,
                 "members": list(self.members),
+                "learners": list(self.learners),
                 "snapshots_sent": self.snapshots_sent,
                 "snapshots_installed": self.snapshots_installed,
                 "elections_started": self.elections_started,
@@ -331,10 +348,14 @@ class RaftNode:
                 return [self._apply_results[e["index"]] for e in entries]
 
     def _replicate_and_wait(self, target: int) -> None:
-        peers = [m for m in self.members if m != self.node_id]
+        peers = self._peers()
         if not peers:  # single-replica group: commit == append
             self._advance_commit()
             return
+        if all(p not in self.members for p in peers):
+            # learners only (single-voter group mid-migration): the
+            # voter quorum is already satisfied by the local append
+            self._advance_commit()
         for p in peers:
             t = threading.Thread(
                 target=self._sync_peer, args=(p,), daemon=True,
@@ -389,7 +410,7 @@ class RaftNode:
             lock.release()
 
     def _notify_commit(self) -> None:
-        peers = [m for m in self.members if m != self.node_id]
+        peers = self._peers()
         threads = [
             threading.Thread(target=self._sync_peer, args=(p, True),
                              daemon=True,
@@ -562,7 +583,7 @@ class RaftNode:
         with self._lock:
             if not self.is_leader or self._stopped:
                 return
-            peers = [m for m in self.members if m != self.node_id]
+            peers = self._peers()
         for p in peers:
             threading.Thread(
                 target=self._sync_peer, args=(p,), daemon=True,
@@ -791,20 +812,22 @@ class RaftNode:
             self.wal.voted_for = None  # fresh term, fresh vote
             self.wal.save_meta(fsync=True)
 
-    def become_leader(self, term: int, members: list[int]) -> dict:
+    def become_leader(self, term: int, members: list[int],
+                      learners: list[int] | None = None) -> dict:
         with self._lock:
             if term < self.term:
                 raise RpcError(409, f"stale term {term} < {self.term}")
             self.wal.term = term
             self.members = list(members)
+            if learners is not None:
+                self.learners = [l for l in learners if l not in members]
             if not self.is_leader:
                 self._observe("become_leader", {"term": term})
             self.is_leader = True
             self._match = {}
             self._peer_commit = {}
             self._next = {
-                p: self.wal.last_index + 1
-                for p in members if p != self.node_id
+                p: self.wal.last_index + 1 for p in self._peers()
             }
             self.wal.save_meta(fsync=True)
             # single-member group: everything in the log is committed
@@ -813,21 +836,28 @@ class RaftNode:
         self.tick()
         return self.state()
 
-    def set_members(self, term: int, members: list[int]) -> dict:
-        """Master-decreed membership change (reference: ChangeMember)."""
+    def set_members(self, term: int, members: list[int],
+                    learners: list[int] | None = None) -> dict:
+        """Master-decreed membership change (reference: ChangeMember).
+        `learners` replaces the learner set when given (None keeps it) —
+        a learner promoted to voter keeps its _match/_next, so the
+        promotion itself re-replicates nothing."""
         with self._lock:
             if term < self.term:
                 raise RpcError(409, f"stale term {term} < {self.term}")
             self.wal.term = term
             self.members = list(members)
-            for p in members:
-                if p != self.node_id and p not in self._next:
+            if learners is not None:
+                self.learners = [l for l in learners if l not in members]
+            keep = set(self._peers())
+            for p in keep:
+                if p not in self._next:
                     self._next[p] = self.wal.last_index + 1
             self._match = {
-                p: v for p, v in self._match.items() if p in members
+                p: v for p, v in self._match.items() if p in keep
             }
             self._peer_commit = {
-                p: v for p, v in self._peer_commit.items() if p in members
+                p: v for p, v in self._peer_commit.items() if p in keep
             }
             self.wal.save_meta(fsync=True)
             if self.is_leader:

@@ -86,6 +86,15 @@ class RouterServer:
         self._search_flight = SingleFlight()
         self._part_versions: dict[int, int] = {}
         self._part_versions_lock = threading.Lock()
+        # partition-map hot reload (elasticity): newest map version
+        # observed per "db/space" (every PS search/upsert/delete
+        # response stamps the version it served under) plus the
+        # last-known pid set, so a split cutover or migration becomes
+        # visible through ANY response — the stale space entry is
+        # evicted immediately instead of waiting out the TTL, and only
+        # the merged-result entries touching remapped partitions die
+        self._map_versions: dict[str, int] = {}
+        self._space_pids: dict[str, set[int]] = {}
         # TTL is the fallback freshness bound; the watch loop below
         # usually invalidates within one long-poll round trip
         self.space_cache_ttl = SPACE_CACHE_TTL
@@ -157,6 +166,19 @@ class RouterServer:
             "vearch_router_cache_entries",
             "live entries in the merged-result cache", (),
             lambda: {(): float(len(self.result_cache))})
+        m.callback_gauge(
+            "vearch_router_partition_map_version",
+            "newest partition-map version this router has observed "
+            "per space (fed by metadata fetches and the map_version "
+            "stamped on every PS response)", ("db", "space"),
+            self._map_version_series)
+        self._m_map_reloads = m.counter(
+            "vearch_router_map_reloads_total",
+            "partition-map hot reloads by trigger (version = a PS "
+            "response carried a newer map than the cached one; moved "
+            "= a partition RPC 404ed after a remap)", ("trigger",))
+        for t in ("version", "moved"):
+            self._m_map_reloads.inc(t, by=0.0)
 
     def start(self) -> None:
         self.server.start()
@@ -265,6 +287,7 @@ class RouterServer:
                 },
                 "space_cache": len(self._space_cache),
                 "server_cache": len(self._server_cache[1]),
+                "map_versions": dict(self._map_versions),
                 "fanout_pool_size": self._pool._max_workers,
                 "fanout_queue_depth": self._pool._work_queue.qsize(),
                 "result_cache": {
@@ -302,6 +325,80 @@ class RouterServer:
             if v > self._part_versions.get(pid, -1):
                 self._part_versions[pid] = v
 
+    def _map_version_series(self) -> dict:
+        with self._cache_lock:
+            return {tuple(k.split("/", 1)): float(v)
+                    for k, v in self._map_versions.items()}
+
+    def _track_space(self, key: str, space: Space) -> None:
+        """Feed the map-version gauge and retire remapped partitions.
+
+        Pids present in the last map for this space but gone from the
+        fresh one (a split cutover retired the parent; a drain removed
+        a partition) lose their merged-result entries and validity-map
+        slots NOW, not at TTL expiry — and ONLY those: entries computed
+        purely over surviving partitions keep serving."""
+        pids = {p.id for p in space.partitions}
+        old: set[int] | None
+        with self._cache_lock:
+            if space.map_version > self._map_versions.get(key, -1):
+                self._map_versions[key] = space.map_version
+            old = self._space_pids.get(key)
+            self._space_pids[key] = pids
+        removed = (old - pids) if old else set()
+        if removed:
+            self.result_cache.evict_pids(removed)
+            with self._part_versions_lock:
+                for pid in removed:
+                    self._part_versions.pop(pid, None)
+
+    def _observe_map_version(self, skey: tuple[str, str],
+                             version) -> None:
+        """Hot reload on a response-carried map version: every PS
+        search/upsert/delete response stamps the partition-map version
+        it served under, so a router holding a pre-cutover map learns
+        of the remap from the first response that crosses it — the
+        cached space is evicted and the next routing decision fetches
+        the fresh map instead of waiting out the TTL or a watch round
+        trip. Monotonic: late responses with older versions are
+        ignored."""
+        if version is None:
+            return
+        v = int(version)
+        key = f"{skey[0]}/{skey[1]}"
+        stale = False
+        with self._cache_lock:
+            if v > self._map_versions.get(key, -1):
+                self._map_versions[key] = v
+                hit = self._space_cache.get(key)
+                if hit is not None and hit[1].map_version < v:
+                    del self._space_cache[key]
+                    stale = True
+        if stale:
+            self._m_map_reloads.inc("version")
+
+    def _retry_moved(self, skey: tuple[str, str], fn):
+        """One route-level retry when a scatter hits a retired
+        partition: a 404 "partition N not on this node" means this
+        router routed with a map from before a split cutover or
+        migration finished. Drop the cached space and re-run the whole
+        handler body — the retry re-fetches the map and re-routes docs
+        to the surviving partitions. One retry only: a second 404 is a
+        real error and propagates. (`_call_partition` deliberately does
+        NOT retry 404s itself — re-asking the same retired pid can
+        never succeed; the fix is re-routing, which only the route
+        layer can do.)"""
+        try:
+            return fn()
+        except RpcError as e:
+            if e.code != 404 or "partition" not in str(e.msg):
+                raise
+            key = f"{skey[0]}/{skey[1]}"
+            with self._cache_lock:
+                self._space_cache.pop(key, None)
+            self._m_map_reloads.inc("moved")
+            return fn()
+
     @property
     def addr(self) -> str:
         return self.server.addr
@@ -331,6 +428,10 @@ class RouterServer:
             )
             canonical = f"{alias['db_name']}/{alias['space_name']}"
         space = Space.from_dict(data)
+        # runs whether or not the fetch is cached below: the pid-set
+        # diff is what retires remapped partitions from the result
+        # cache, and a watch-raced fetch still carries a valid map
+        self._track_space(key, space)
         with self._cache_lock:
             # a watch event between our fetch and now may have evicted
             # this very key — caching what we fetched would write STALE
@@ -372,7 +473,14 @@ class RouterServer:
 
         servers = self._servers()
         now = time.monotonic()
-        part = next(p for p in space.partitions if p.id == partition_id)
+        part = next((p for p in space.partitions if p.id == partition_id),
+                    None)
+        if part is None:
+            # the routing decision predates a map flip (split cutover /
+            # migration): surface the same 404 a retired PS partition
+            # returns, so _retry_moved re-routes through the fresh map
+            raise RpcError(
+                404, f"partition {partition_id} not in routing map")
         leader = part.leader if part.leader >= 0 else part.replicas[0]
         candidates = [r for r in part.replicas if r in servers]
         healthy = [r for r in candidates
@@ -627,6 +735,19 @@ class RouterServer:
         return by_partition
 
     def _h_upsert(self, body: dict, _parts) -> dict:
+        import uuid
+
+        skey = (body["db_name"], body["space_name"])
+        # ids are assigned BEFORE the moved-retry boundary: a retry
+        # must re-route the SAME ids (minting fresh uuids on the rerun
+        # would duplicate docs already written to healthy partitions)
+        body["documents"] = [
+            d if "_id" in d else {**d, "_id": uuid.uuid4().hex}
+            for d in body["documents"]
+        ]
+        return self._retry_moved(skey, lambda: self._upsert_impl(body))
+
+    def _upsert_impl(self, body: dict) -> dict:
         skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
         self._ensure_pool_capacity(len(space.partitions))
@@ -675,6 +796,7 @@ class RouterServer:
                 # read-your-writes search through this router miss the
                 # cache instead of serving pre-write results
                 self._note_apply_version(pid, r.get("apply_version"))
+                self._observe_map_version(skey, r.get("map_version"))
                 r["_rpc_ms"] = round((time.monotonic() - t0) * 1e3, 3)
                 return pid, r
 
@@ -821,7 +943,9 @@ class RouterServer:
         out: dict | None = None
         killed = False
         try:
-            out = self._search_impl(body)
+            out = self._retry_moved(
+                (body["db_name"], body["space_name"]),
+                lambda: self._search_impl(body))
             return out
         except RpcError as e:
             # a killed request (deadline/slow/operator) is terminal —
@@ -1075,6 +1199,7 @@ class RouterServer:
             # every partial carries the partition's apply version —
             # feed the router's validity map even on plain searches
             self._note_apply_version(pid, r.get("apply_version"))
+            self._observe_map_version(skey, r.get("map_version"))
             r["_rpc_ms"] = round((_time.monotonic() - t0) * 1e3, 3)
             return pid, r
 
@@ -1254,6 +1379,10 @@ class RouterServer:
 
     def _h_query(self, body: dict, _parts) -> dict:
         skey = (body["db_name"], body["space_name"])
+        return self._retry_moved(skey, lambda: self._query_impl(body))
+
+    def _query_impl(self, body: dict) -> dict:
+        skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
         # parse/validate BEFORE branching so an invalid sort 400s on the
         # document_ids path too instead of being silently ignored
@@ -1376,6 +1505,10 @@ class RouterServer:
 
     def _h_delete(self, body: dict, _parts) -> dict:
         skey = (body["db_name"], body["space_name"])
+        return self._retry_moved(skey, lambda: self._delete_impl(body))
+
+    def _delete_impl(self, body: dict) -> dict:
+        skey = (body["db_name"], body["space_name"])
         space = self._space(*skey)
         if body.get("document_ids"):
             keys_in = [str(k) for k in body["document_ids"]]
@@ -1393,6 +1526,7 @@ class RouterServer:
                 r = self._call_partition(skey, pid, "/ps/doc/delete",
                                          {"keys": keys})
                 self._note_apply_version(pid, r.get("apply_version"))
+                self._observe_map_version(skey, r.get("map_version"))
                 return r
 
             futures = [
@@ -1415,6 +1549,7 @@ class RouterServer:
                     skey, p.id, "/ps/doc/delete",
                     {"filters": body.get("filters"), "limit": remaining})
                 self._note_apply_version(p.id, out.get("apply_version"))
+                self._observe_map_version(skey, out.get("map_version"))
                 total += out["deleted"]
                 remaining -= out["deleted"]
             return {"total": total}
@@ -1424,6 +1559,7 @@ class RouterServer:
             r = self._call_partition(skey, pid, "/ps/doc/delete",
                                      {"filters": body.get("filters")})
             self._note_apply_version(pid, r.get("apply_version"))
+            self._observe_map_version(skey, r.get("map_version"))
             return r
 
         futures = [self._pool.submit(send_filter, p.id) for p in space.partitions]

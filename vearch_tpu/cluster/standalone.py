@@ -36,6 +36,18 @@ class StandaloneCluster:
         self.router.start()
         return self
 
+    def add_ps(self) -> PSServer:
+        """Join one more partition server to the running cluster — the
+        target for migration/drain tests and live scale-out. Returns
+        the started PS (it registers with the master on its own)."""
+        ps = PSServer(
+            data_dir=f"{self.data_dir}/ps{len(self.ps_nodes)}",
+            master_addr=self.master.addr,
+        )
+        ps.start()
+        self.ps_nodes.append(ps)
+        return ps
+
     def stop(self) -> None:
         if self.router:
             self.router.stop()

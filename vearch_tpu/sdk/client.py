@@ -15,8 +15,18 @@ from vearch_tpu.cluster import rpc
 
 
 class VearchClient:
-    def __init__(self, router_addr: str):
+    def __init__(self, router_addr: str, master_addr: str | None = None):
         self.addr = router_addr.replace("http://", "")
+        # elastic/admin verbs (split/migrate/rebalance/drain) hit the
+        # master directly — they reshape the cluster, not one request
+        self.master_addr = (master_addr.replace("http://", "")
+                            if master_addr else None)
+
+    def _master(self) -> str:
+        if self.master_addr is None:
+            raise ValueError(
+                "elastic operations need VearchClient(master_addr=...)")
+        return self.master_addr
 
     # -- admin (proxied to master) -------------------------------------------
 
@@ -249,3 +259,70 @@ class VearchClient:
             "db_name": db_name, "space_name": space_name, "field": field,
             "operator_type": "DROP",
         })
+
+    # -- elasticity (master-side; see docs/ELASTICITY.md) --------------------
+
+    def split_partition(self, db_name: str, space_name: str,
+                        partition_id: int,
+                        timeout_s: float = 600.0) -> dict:
+        """Start an online split of `partition_id` into two hash-range
+        children. Returns {"job_id", "status"}; poll with
+        ``elastic_job`` / ``wait_elastic_job``."""
+        return rpc.call(self._master(), "POST", "/partitions/split", {
+            "db_name": db_name, "space_name": space_name,
+            "partition_id": partition_id, "timeout_s": timeout_s,
+        })
+
+    def migrate_partition(self, partition_id: int, to_node: int,
+                          from_node: int | None = None,
+                          timeout_s: float = 600.0) -> dict:
+        """Move one replica of `partition_id` onto PS `to_node` via
+        snapshot-streamed catch-up, then retire the source replica."""
+        body: dict[str, Any] = {"partition_id": partition_id,
+                                "to_node": to_node, "timeout_s": timeout_s}
+        if from_node is not None:
+            body["from_node"] = from_node
+        return rpc.call(self._master(), "POST", "/partitions/migrate",
+                        body)
+
+    def rebalance(self, apply: bool = False, max_moves: int = 4) -> dict:
+        """Compute (and with ``apply=True`` execute) a load-leveling
+        plan of replica moves; the plan rides back either way."""
+        return rpc.call(self._master(), "POST", "/cluster/rebalance",
+                        {"apply": apply, "max_moves": max_moves})
+
+    def drain(self, node_id: int, apply: bool = False) -> dict:
+        """Plan (and with ``apply=True`` execute) moving every replica
+        off PS `node_id`, so it can be decommissioned."""
+        return rpc.call(self._master(), "POST", "/cluster/drain",
+                        {"node_id": node_id, "apply": apply})
+
+    def cluster_plan(self) -> dict:
+        return rpc.call(self._master(), "GET", "/cluster/plan")
+
+    def elastic_job(self, job_id: str) -> dict:
+        return rpc.call(self._master(), "GET", f"/cluster/jobs/{job_id}")
+
+    def elastic_jobs(self) -> list[dict]:
+        return rpc.call(self._master(), "GET", "/cluster/jobs")["jobs"]
+
+    def wait_elastic_job(self, job_id: str,
+                         timeout_s: float = 600.0) -> dict:
+        """Block until the job leaves "running" (or `timeout_s` runs
+        out). Raises TimeoutError on the deadline, RuntimeError when
+        the job finishes in error."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            job = self.elastic_job(job_id)
+            if job["status"] != "running":
+                if job["status"] == "error":
+                    raise RuntimeError(
+                        f"elastic job {job_id} failed: {job.get('error')}")
+                return job
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic job {job_id} still running after "
+                    f"{timeout_s}s (phase {job.get('phase')})")
+            _time.sleep(0.2)
