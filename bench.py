@@ -511,6 +511,69 @@ def main():
     }
     emit("phase_breakdown", **phase_ms)
 
+    # -- mesh_scaling: the pod-slice data plane (docs/POD_SLICE.md) at
+    # 1/2/4/8 devices — the same ONE-program fused scan+rerank placed on
+    # a make_mesh(n_dev) subset, QPS and frac_of_roofline per count (the
+    # roofline denominator scales with the chip count). Resumable like
+    # every phase: device counts already in the partials file are
+    # skipped on a retry, so a mid-sweep tunnel death only re-runs the
+    # missing counts.
+    from vearch_tpu.engine.types import MetricType as _MT
+    from vearch_tpu.parallel import mesh as mesh_lib
+    from vearch_tpu.parallel.sharded import sharded_ivf_search
+
+    done_counts = set()
+    try:
+        with open(partial_path) as pf:
+            for ln in pf:
+                try:
+                    prec = json.loads(ln)
+                except ValueError:
+                    continue
+                if prec.get("phase") == "mesh_scaling":
+                    done_counts.add(prec.get("devices"))
+    except OSError:
+        pass
+    mesh_diag = {}
+    host_mirror = (np.asarray(approx8), np.asarray(mscale),
+                   np.asarray(mvsq), np.asarray(dvalid).reshape(-1))
+    host_rerank = (np.asarray(basebuf), np.asarray(base_sqn))
+    for n_dev in (1, 2, 4, 8):
+        if n_dev > len(jax.devices()):
+            break
+        if n_dev in done_counts:
+            mesh_diag[str(n_dev)] = {"resumed": True}
+            continue
+        m = mesh_lib.make_mesh(n_dev)
+        a8_s, _ = mesh_lib.shard_rows(m, host_mirror[0])
+        sc_s, _ = mesh_lib.shard_rows(m, host_mirror[1])
+        vsq_s, _ = mesh_lib.shard_rows(m, host_mirror[2])
+        v_s, _ = mesh_lib.shard_rows(m, host_mirror[3])
+        b_s, _ = mesh_lib.shard_rows(m, host_rerank[0])
+        bsqn_s, _ = mesh_lib.shard_rows(m, host_rerank[1])
+        q_rep = mesh_lib.replicate(
+            m, np.ascontiguousarray(queries[:batch], np.float32))
+
+        def _mesh_once(mm=m, a=a8_s, s=sc_s, v=vsq_s, ok=v_s,
+                       b=b_s, bs=bsqn_s, q=q_rep):
+            return jax.block_until_ready(sharded_ivf_search(
+                mm, None, None, a, s, v, ok, b, bs, q,
+                rdepth, 10, _MT.L2, _MT.L2, "auto", idx.mirror_storage))
+
+        _mesh_once()  # compile this mesh shape
+        t_mesh = _best(_mesh_once)
+        qps_m = batch / t_mesh if t_mesh else 0.0
+        roof_m = perf_model.roofline_qps(
+            n, d, peak * n_dev, rerank_r=rdepth_cfg)
+        row = {
+            "qps": round(qps_m, 1),
+            "roofline_qps": round(roof_m, 1),
+            "frac_of_roofline": round(qps_m / roof_m, 4) if roof_m else 0.0,
+        }
+        mesh_diag[str(n_dev)] = row
+        emit("mesh_scaling", devices=n_dev, batch=batch, **row)
+        del a8_s, sc_s, vsq_s, v_s, b_s, bsqn_s, q_rep
+
     # recall gate vs exact bf16 scan on device
     buf, sqn, _ = store.device_buffer()
     bs, bi = brute_force_search(
@@ -587,6 +650,7 @@ def main():
         "recall_at_10": round(recall, 4),
         "phase_ms": phase_ms,
         "roofline": roofline_diag,
+        "mesh_scaling": mesh_diag,
         "cache": cache_diag,
         **glove_diag,
         **cpu_diag,

@@ -31,6 +31,10 @@ def _engine(metric=MetricType.L2, storage="int8"):
     params = {
         "ncentroids": 16, "nsubvector": 8, "train_iters": 4,
         "training_threshold": 256, "mirror_storage": storage,
+        # these tests assert the single-device fused/unfused ledgers;
+        # under the forced-8-device conftest mesh auto would reroute
+        # every full-mode search through the mesh program
+        "mesh_serving": "off",
     }
     schema = TableSchema("t", [
         FieldSchema("group", DataType.INT),

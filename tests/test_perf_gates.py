@@ -58,6 +58,9 @@ def _build(index_type, params, n=N, warmup=None):
 IVFPQ_PARAMS = {
     "ncentroids": 16, "nsubvector": 8, "train_iters": 4,
     "training_threshold": 256,
+    # single-device ledger gates; the mesh path has its own gates in
+    # test_mesh_serving.py (conftest forces 8 devices → auto would mesh)
+    "mesh_serving": "off",
 }
 
 

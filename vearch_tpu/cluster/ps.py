@@ -90,6 +90,8 @@ def _profile_from_timing(timing: dict) -> dict:
         out["doc_count"] = timing["doc_count"]
     if "micro_batch_rows" in timing:
         out["micro_batch_rows"] = timing["micro_batch_rows"]
+    if "mesh" in timing:
+        out["mesh"] = timing["mesh"]
     return out
 
 
@@ -350,6 +352,26 @@ class PSServer:
                          "(cached accounting, feeds the write limit)",
                          (),
                          lambda: {(): float(self.memory_used_bytes())})
+
+        def _mesh_devices():
+            # devices the mesh data plane spans, per partition; 0 when
+            # the partition serves single-device (mesh_serving off, one
+            # visible device, or a disk-store field)
+            out = {}
+            for pid, eng in list(self.engines.items()):
+                try:
+                    info = eng.mesh_info()
+                except Exception:
+                    info = None
+                out[(str(pid),)] = float(
+                    (info or {}).get("devices", 0)
+                )
+            return out
+
+        m.callback_gauge("vearch_engine_mesh_devices",
+                         "devices the mesh serving data plane spans "
+                         "per partition (0 = single-device path)",
+                         ("partition",), _mesh_devices)
 
         # write path (tentpole: ingest observability symmetric with the
         # read path) — throughput counters per partition, kill counters
@@ -2450,7 +2472,15 @@ class PSServer:
                     ),
                     "raft": self.raft_nodes[pid].state()
                     if pid in self.raft_nodes else None,
+                    "mesh": self._mesh_info_safe(eng),
                 }
                 for pid, eng in self.engines.items()
             },
         }
+
+    @staticmethod
+    def _mesh_info_safe(eng) -> dict | None:
+        try:
+            return eng.mesh_info()
+        except Exception:
+            return None

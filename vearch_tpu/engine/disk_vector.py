@@ -78,7 +78,6 @@ class DiskRawVectorStore(RawVectorStore):
         self._device_sqnorm = None
         self._device_rows = 0
         self._sh_cache = None
-        self._sh_sqnorm = None
 
     def _map(self, capacity: int) -> np.memmap:
         rowbytes = self.dimension * self._itemsize

@@ -593,6 +593,12 @@ class Engine:
             mb = self._microbatcher
             if mb is not None:  # propagate to a live batcher
                 mb.max_rows = self.micro_batch_max_rows
+        if "mesh_serving" in cfg:
+            # space-level toggle for the multi-chip data plane: fan the
+            # mode into every vector field's index params (per-field
+            # overrides still win via index_params below)
+            for index in self.indexes.values():
+                index.params.params["mesh_serving"] = cfg["mesh_serving"]
         for name, params in (cfg.get("index_params") or {}).items():
             if name in self.indexes:
                 self.indexes[name].params.params.update(params)
@@ -1144,7 +1150,35 @@ class Engine:
             for tag, t0, t1 in capture.events
             if t1 is not None
         )
+        spans.extend(
+            [f"mesh.{name}", mono_us(t0), int((t1 - t0) * 1e6)]
+            for name, t0, t1 in capture.mesh_phases
+        )
         trace["_phase_spans"] = spans
+        if capture.mesh_phases or any(t.startswith("sharded") for t in tags):
+            info = self.mesh_info()
+            if info is not None:
+                trace["mesh"] = info
+
+    def mesh_info(self) -> dict[str, Any] | None:
+        """Aggregate mesh data-plane summary over the engine's vector
+        fields (surfaced in /ps/stats and profile:true traces); None
+        when no field serves through the mesh."""
+        fields = {}
+        for name, index in self.indexes.items():
+            try:
+                info = index.mesh_info()
+            except Exception:
+                info = None
+            if info is not None:
+                fields[name] = info
+        if not fields:
+            return None
+        out: dict[str, Any] = {
+            "devices": max(f["devices"] for f in fields.values()),
+            "fields": fields,
+        }
+        return out
 
     def _predicted_scan_bytes(self, name: str) -> int:
         """Perf-model prediction of stage-1 scan HBM read bytes for one

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from vearch_tpu.ops.distance import sqnorms
+from vearch_tpu.ops.distance import host_sqnorms
 
 
 class RawVectorStore:
@@ -92,7 +92,9 @@ class RawVectorStore:
         cap = self._host.shape[0]
         if self._device is None or self._device.shape[0] != cap:
             self._device = jnp.asarray(self._host, dtype=self.store_dtype)
-            self._device_sqnorm = sqnorms(self._device)
+            self._device_sqnorm = jnp.asarray(
+                host_sqnorms(np.asarray(self._device))
+            )
             self._device_rows = n
         elif self._device_rows < n:
             tail = jnp.asarray(
@@ -102,33 +104,40 @@ class RawVectorStore:
                 self._device, tail, (self._device_rows, 0)
             )
             self._device_sqnorm = jax.lax.dynamic_update_slice(
-                self._device_sqnorm, sqnorms(tail), (self._device_rows,)
+                self._device_sqnorm,
+                jnp.asarray(host_sqnorms(np.asarray(tail))),
+                (self._device_rows,),
             )
             self._device_rows = n
         return self._device, self._device_sqnorm, n
 
     _sh_cache = None
-    _sh_sqnorm: jax.Array | None = None
 
     def device_buffer_sharded(self, mesh) -> tuple[jax.Array, jax.Array, int]:
         """Row-sharded raw buffer over the mesh "data" axis (rerank path
-        of a mesh-spanning partition). Re-placed in full when rows grew;
-        see Int8Mirror.flush_sharded for the trade-off."""
-        from vearch_tpu.ops.distance import sqnorms as _sqnorms
+        of a mesh-spanning partition). Growth within the cached capacity
+        tail-appends only the new rows per shard; the derived sqnorm
+        column is maintained on device by the cache (sqnorm_of=0) so it
+        stays bit-identical to a full rebuild."""
         from vearch_tpu.parallel.mesh import ShardedRowCache
 
         if self._sh_cache is None:
-            self._sh_cache = ShardedRowCache(align=128)
+            self._sh_cache = ShardedRowCache(align=128, sqnorm_of=0)
 
         def build(cap):
             host = np.zeros((cap, self.dimension), dtype=np.float32)
             host[: self._n] = self._host[: self._n]
             return (host.astype(self.store_dtype),)
 
-        (base,), rebuilt = self._sh_cache.get(mesh, self._n, build)
-        if rebuilt or self._sh_sqnorm is None:
-            self._sh_sqnorm = _sqnorms(base)
-        return base, self._sh_sqnorm, self._n
+        def append(lo, hi):
+            win = np.zeros((hi - lo, self.dimension), dtype=np.float32)
+            m = min(hi, self._host.shape[0]) - lo
+            if m > 0:
+                win[:m] = self._host[lo : lo + m]
+            return (win.astype(self.store_dtype),)
+
+        (base,), _ = self._sh_cache.get(mesh, self._n, build, append)
+        return base, self._sh_cache.sqnorm, self._n
 
     # -- persistence ---------------------------------------------------------
 
@@ -144,7 +153,6 @@ class RawVectorStore:
             self._device_rows = 0
             if self._sh_cache is not None:
                 self._sh_cache.invalidate()
-            self._sh_sqnorm = None
 
     def load_parts(self, paths: list[str]) -> None:
         """Restore from per-segment row slices in order (segmented dump
@@ -167,4 +175,3 @@ class RawVectorStore:
         self._device_rows = 0
         if self._sh_cache is not None:
             self._sh_cache.invalidate()
-        self._sh_sqnorm = None

@@ -98,6 +98,18 @@ class VectorIndex(abc.ABC):
             self.store.store_dtype.itemsize,
         )
 
+    def device_footprint_per_device_bytes(self) -> int:
+        """Modeled resident HBM bytes on EACH chip. Single-device
+        indexes hold everything on one chip; mesh-serving indexes
+        override with the sharded/replicated split
+        (ops/perf_model.per_device_bytes)."""
+        return self.device_footprint_bytes()
+
+    def mesh_info(self) -> dict[str, Any] | None:
+        """Mesh data-plane placement summary, None when this index is
+        not mesh-serving (single device)."""
+        return None
+
     # -- persistence (index-specific state only; raw vectors are dumped by
     #    the engine — reference: index is rebuildable, vectors are durable)
 

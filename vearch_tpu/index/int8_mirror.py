@@ -128,10 +128,10 @@ class Int8Mirror:
         """Device views row-sharded over the mesh "data" axis — one
         logical partition spanning all chips (the capacity regime: rows
         beyond a single chip's HBM). Rows are padded so every shard is
-        512-aligned (block-max top-k contract). The sharded copy is
-        re-placed in full when rows grew since the last flush — mesh
-        mode trades incremental tail updates for capacity; realtime
-        ingest still lands through absorb + re-flush.
+        512-aligned (block-max top-k contract). Growth within the cached
+        capacity tail-appends per shard (one H2D per touched device of
+        only the new rows); a full re-place happens only on capacity
+        change — realtime absorb on a mesh partition stays incremental.
         """
         if self._sh_cache is None:
             from vearch_tpu.parallel.mesh import ShardedRowCache
@@ -148,7 +148,14 @@ class Int8Mirror:
             hv[:n] = self._h_vsq[:n]
             return h8, hs, hv
 
-        arrays, _ = self._sh_cache.get(mesh, self._n, build)
+        def append(lo, hi):
+            return (
+                np.ascontiguousarray(self._h8[lo:hi]),
+                np.ascontiguousarray(self._h_scale[lo:hi]),
+                np.ascontiguousarray(self._h_vsq[lo:hi]),
+            )
+
+        arrays, _ = self._sh_cache.get(mesh, self._n, build, append)
         return arrays
 
     _sh_cache = None

@@ -366,14 +366,17 @@ class IVFPQIndex(_IVFBase):
         self.full_scan_limit = int(params.get("full_scan_limit", 16_000_000))
         # one partition spanning the whole device mesh (capacity regime:
         # rows beyond a single chip's HBM — SURVEY §2.3 "intra-node
-        # parallelism", the axis the reference lacks). "auto" engages
-        # when more than one device is visible.
-        dp = params.get("data_parallel", False)
-        import jax as _jax
-
-        self.data_parallel = (
-            len(_jax.devices()) > 1 if dp == "auto" else bool(dp)
+        # parallelism", the axis the reference lacks). Config
+        # `mesh_serving: auto|on|off` ("data_parallel" stays as a
+        # boolean back-compat alias); "auto" — the default — engages
+        # whenever more than one device is visible.
+        self.mesh_serving = self._norm_mesh_serving(
+            params.get("mesh_serving", params.get("data_parallel", "auto"))
         )
+        # row -> cluster assignment, docid-ordered (the mesh probe gate
+        # reads it row-sharded in lockstep with the int8 mirror)
+        self._assign_host = np.zeros(0, dtype=np.int32)
+        self._assign_cache = None
         self.codebooks: jax.Array | None = None  # [m, ksub, dsub]
         self._codes: np.ndarray | None = None  # [n_indexed, m] host codes
         # probe-mode state (bucket-grouped)
@@ -388,6 +391,38 @@ class IVFPQIndex(_IVFBase):
         ).lower()
         self._mirror = Int8Mirror(store.dimension,
                                   storage=self.mirror_storage)
+
+    @staticmethod
+    def _norm_mesh_serving(value) -> str:
+        ms = {True: "on", False: "off"}.get(value, str(value).lower())
+        if ms in ("true", "1"):
+            ms = "on"
+        elif ms in ("false", "0", "none"):
+            ms = "off"
+        if ms not in ("auto", "on", "off"):
+            raise ValueError(f"mesh_serving must be auto|on|off, got {value!r}")
+        return ms
+
+    def _mesh_enabled(self, params: dict | None) -> bool:
+        """Whether this search serves through the device mesh. Read per
+        request so apply_config({"index_params": {"mesh_serving": ...}})
+        and per-request overrides both take effect without a rebuild."""
+        ms = self._norm_mesh_serving(
+            (params or {}).get(
+                "mesh_serving",
+                self.params.get(
+                    "mesh_serving", self.params.get("data_parallel", "auto")
+                ),
+            )
+        )
+        if ms == "auto":
+            return len(jax.devices()) > 1
+        return ms == "on"
+
+    # back-compat surface (pre-mesh_serving callers/tests)
+    @property
+    def data_parallel(self) -> bool:
+        return self._mesh_enabled(None)
 
     def _device_state_arrays(self) -> tuple:
         return super()._device_state_arrays() + (
@@ -467,6 +502,14 @@ class IVFPQIndex(_IVFBase):
             grown[: self._codes.shape[0]] = self._codes
             self._codes = grown
         self._codes[start_docid : start_docid + rows.shape[0]] = codes
+        if self._assign_host.shape[0] < need:
+            ga = np.zeros(max(need, self._assign_host.shape[0] * 2),
+                          dtype=np.int32)
+            ga[: self._assign_host.shape[0]] = self._assign_host
+            self._assign_host = ga
+        self._assign_host[start_docid:need] = assign.astype(np.int32)
+        if self._assign_cache is not None:
+            self._assign_cache.lower_rows(start_docid)
 
         # docid-ordered int8 mirror for the full-scan path: decode the PQ
         # approximation, rotate back to the original space (OPQ), add the
@@ -547,23 +590,30 @@ class IVFPQIndex(_IVFBase):
             else self.metric
         )
         mode = (params or {}).get("scan_mode", self.scan_mode)
+        mesh_on = self._mesh_enabled(params)
         if mode == "auto":
             # the full-scan budget is per chip: a mesh-spanning
             # partition scans its rows in parallel, so the cliff to
             # probe mode scales with the mesh
             limit = self.full_scan_limit
-            if self.data_parallel:
+            if mesh_on:
                 limit *= max(len(jax.devices()), 1)
             mode = "full" if self.indexed_count <= limit else "probe"
         from vearch_tpu.index._store_paths import is_disk_store
 
+        scan_kernel = (params or {}).get(
+            "scan_kernel", self.params.get("scan_kernel", "xla")
+        )
         if (
-            mode == "full" and self.data_parallel
+            mode == "full" and mesh_on
+            and scan_kernel != "pallas"
             and not is_disk_store(self.store)
         ):
             # mesh mode needs the raw buffer sharded across HBM — a
             # disk store can't provide that; fall through to the
-            # single-device scan with host-gathered rerank
+            # single-device scan with host-gathered rerank. The pallas
+            # kernel is likewise a single-device program (hardware A/B
+            # flag), so it keeps the single-device path too.
             return self._search_mesh(q, k, valid_mask, params, metric)
         if mode == "full":
             approx8, scale, vsq = self._mirror.flush()
@@ -572,9 +622,6 @@ class IVFPQIndex(_IVFBase):
             r = min(self._rerank_depth(k, params), max(self.indexed_count, 1))
             topk_mode = (params or {}).get(
                 "topk_mode", self.params.get("topk_mode", "auto")
-            )
-            scan_kernel = (params or {}).get(
-                "scan_kernel", self.params.get("scan_kernel", "xla")
             )
             fused = (params or {}).get(
                 "fused_rerank", self.params.get("fused_rerank", True)
@@ -690,29 +737,27 @@ class IVFPQIndex(_IVFBase):
         scores, ids = jax.device_get((scores, ids))
         return self._pad_to_k(scores, ids, k)
 
-    def _search_mesh(
-        self, q: np.ndarray, k: int, valid_mask, params, metric
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Mesh-spanning full scan: the int8 mirror and the raw rerank
-        buffer are row-sharded over all devices; candidate merge is an
-        all_gather + re-top-k, rerank merge a pmax — no host round trips
-        (reference analogue: none; this is the TPU capacity axis on top
-        of the reference's partition sharding)."""
-        from vearch_tpu.parallel import mesh as mesh_lib
-        from vearch_tpu.parallel.sharded import (
-            sharded_exact_rerank,
-            sharded_int8_search,
+    def _mesh_nprobe(self, params: dict | None) -> int:
+        """Coarse-probe gate depth of the mesh program (0 = ungated full
+        scan). Unlike single-device "probe" mode this gates the docid-
+        ordered mirror inside the one fused program instead of switching
+        to the bucket-grouped layout."""
+        p = params or {}
+        return min(
+            int(p.get("mesh_nprobe", self.params.get("mesh_nprobe", 0))),
+            self.nlist,
         )
 
-        mesh = mesh_lib.default_mesh()
-        a8, scale, vsq = self._mirror.flush_sharded(mesh)
-        n = self.indexed_count
-        # the sharded mask re-uploads only when the engine handed us a
-        # different mask object (the engine caches its alive mask per
-        # bitmap version; filter masks are fresh arrays by nature). The
-        # strong reference to the source mask makes the identity check
-        # sound — a live object's id cannot be reused.
-        cap = self._mirror._sh_cache.capacity(mesh, n)
+    def _mesh_valid_sharded(self, mesh, valid_mask, n: int, cap: int):
+        """Sharded validity mask, cached per source-mask identity.
+
+        The sharded mask re-uploads only when the engine handed us a
+        different mask object (the engine caches its alive mask per
+        bitmap version; filter masks are fresh arrays by nature). The
+        strong reference to the source mask makes the identity check
+        sound — a live object's id cannot be reused."""
+        from vearch_tpu.parallel import mesh as mesh_lib
+
         fresh = not (
             getattr(self, "_mesh_valid_src", None) is valid_mask
             and valid_mask is not None
@@ -730,26 +775,143 @@ class IVFPQIndex(_IVFBase):
             self._mesh_valid_src = valid_mask
             self._mesh_valid_n = n
             self._mesh_valid_cap = cap
-        valid_sh = self._mesh_valid
+        return self._mesh_valid
+
+    def _assign_sharded(self, mesh, n: int):
+        """Row->cluster assignment sharded in lockstep with the mirror
+        (same 512 alignment, so local row offsets line up per shard)."""
+        if self._assign_cache is None:
+            from vearch_tpu.parallel.mesh import ShardedRowCache
+
+            self._assign_cache = ShardedRowCache(align=512)
+
+        def build(cap):
+            host = np.zeros(cap, dtype=np.int32)
+            host[:n] = self._assign_host[:n]
+            return (host,)
+
+        def append(lo, hi):
+            return (np.ascontiguousarray(self._assign_host[lo:hi]),)
+
+        (assign,), _ = self._assign_cache.get(mesh, n, build, append)
+        return assign
+
+    def _search_mesh(
+        self, q: np.ndarray, k: int, valid_mask, params, metric
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Mesh-spanning serving path: the int8 mirror, the raw rerank
+        buffer, and the row->cluster assignment are row-sharded over all
+        devices; an optional coarse-probe gate, the compressed scan, the
+        all_gather candidate merge, the exact rerank, and the pmax score
+        merge all run inside ONE jitted shard_map program — no host
+        round trips (reference analogue: none; this is the TPU capacity
+        axis on top of the reference's partition sharding). Placement is
+        incremental: absorb tail-appends only the new rows per shard."""
+        import time as _time
+
+        from vearch_tpu.parallel import mesh as mesh_lib
+        from vearch_tpu.parallel.sharded import (
+            sharded_exact_rerank,
+            sharded_int8_search,
+            sharded_ivf_search,
+        )
+
+        t_place0 = _time.monotonic()
+        mesh = mesh_lib.default_mesh()
+        a8, scale, vsq = self._mirror.flush_sharded(mesh)
+        n = self.indexed_count
+        cap = self._mirror._sh_cache.capacity(mesh, n)
+        valid_sh = self._mesh_valid_sharded(mesh, valid_mask, n, cap)
+        nprobe = self._mesh_nprobe(params)
+        cents = assign_sh = None
+        if nprobe > 0:
+            cents = mesh_lib.replicate(mesh, np.asarray(self.centroids))
+            assign_sh = self._assign_sharded(mesh, n)
         qrep = mesh_lib.replicate(mesh, np.asarray(q, np.float32))
         r = min(self._rerank_depth(k, params), max(n, 1))
         topk_mode = (params or {}).get(
             "topk_mode", self.params.get("topk_mode", "auto")
         )
+        fused = (params or {}).get(
+            "fused_rerank", self.params.get("fused_rerank", True)
+        )
+        rerank = self._exact_rerank_enabled(params)
+        if fused and rerank:
+            base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
+            ivf_ops.note_mesh_phase("place", t_place0, _time.monotonic())
+            ivf_ops.note_dispatch("sharded_fused_scan_rerank")
+            scores, ids = sharded_ivf_search(
+                mesh, cents, assign_sh, a8, scale, vsq, valid_sh,
+                base, base_sqn, qrep, max(r, k),
+                min(k, max(r, k)),
+                scan_metric=metric, rerank_metric=self.metric,
+                topk_mode=topk_mode, storage=self.mirror_storage,
+                nprobe=nprobe,
+            )
+            scores, ids = jax.device_get((scores, ids))
+            return self._pad_to_k(scores, ids, k)
+        ivf_ops.note_mesh_phase("place", t_place0, _time.monotonic())
+        ivf_ops.note_dispatch("sharded_scan")
         cand_s, cand_i = sharded_int8_search(
             mesh, a8, scale, vsq, valid_sh, qrep, max(r, k), metric,
             topk_mode, storage=self.mirror_storage,
         )
-        if not self._exact_rerank_enabled(params):
+        if not rerank:
             scores, ids = jax.device_get((cand_s, cand_i))
             return self._pad_to_k(scores[:, :k], ids[:, :k], k)
         base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
+        ivf_ops.note_dispatch("sharded_rerank")
         scores, ids = sharded_exact_rerank(
             mesh, qrep.astype(base.dtype), cand_i, base, base_sqn,
             min(k, int(cand_i.shape[1])), self.metric,
         )
         scores, ids = jax.device_get((scores, ids))
         return self._pad_to_k(scores, ids, k)
+
+    def mesh_info(self) -> dict[str, Any] | None:
+        """Mesh data-plane placement summary (surfaced in /ps/stats and
+        profile:true explains); None when mesh serving is off."""
+        if not self._mesh_enabled(None):
+            return None
+        from vearch_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.default_mesh()
+        sh = self._mirror._sh_cache
+        info: dict[str, Any] = {
+            "devices": int(mesh.size),
+            "data_shards": int(mesh.shape["data"]),
+            "query_shards": int(mesh.shape["query"]),
+            "per_device_bytes": self.device_footprint_per_device_bytes(),
+        }
+        if sh is not None:
+            info["mirror_placement"] = dict(sh.stats)
+        rs = getattr(self.store, "_sh_cache", None)
+        if rs is not None:
+            info["raw_placement"] = dict(rs.stats)
+        return info
+
+    def device_footprint_per_device_bytes(self) -> int:
+        """Per-device resident HBM model of mesh serving: row-sharded
+        state (mirror, raw base, assignment) divides by the shard count;
+        replicated state (centroids, bucket tensors when published)
+        rides whole on every chip (ops/perf_model.per_device_bytes)."""
+        if not self._mesh_enabled(None):
+            return self.device_footprint_bytes()
+        from vearch_tpu.ops import perf_model
+        from vearch_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.default_mesh()
+        n_shards = int(mesh.shape["data"])
+        sharded = self._mirror.device_bytes() + \
+            perf_model.raw_store_footprint_bytes(
+                self.store.capacity, self.store.dimension,
+                self.store.store_dtype.itemsize,
+            ) + self._assign_host.shape[0] * 4
+        replicated = 0
+        for a in self._device_state_arrays():
+            if a is not None:
+                replicated += int(a.size) * a.dtype.itemsize
+        return perf_model.per_device_bytes(sharded, replicated, n_shards)
 
     def dump_state(self) -> dict[str, Any]:
         state = super().dump_state()

@@ -7,11 +7,11 @@ partition's vectors over a (data x query) mesh of all local devices and
 merges per-shard top-k with an `all_gather` on ICI
 (parallel/sharded.py). The cluster layer still shards across hosts.
 
-Realtime model: absorb re-places the whole host buffer on the mesh when
-rows arrived (placement is one H2D per device; fine at refresh-interval
-cadence — an incremental per-shard tail-append is a round-2 item). The
-deletion/filter mask is sharded per search, cached per bitmap version by
-the engine upstream.
+Realtime model: absorb tail-appends per shard — one H2D per touched
+device of only the new rows (parallel/mesh.py ShardedRowCache); a full
+re-place happens only when the sharded capacity grows. The
+deletion/filter mask is sharded per mask identity, cached per bitmap
+version by the engine upstream.
 """
 
 from __future__ import annotations
@@ -23,7 +23,9 @@ from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.engine.types import IndexParams, MetricType
 from vearch_tpu.index.base import VectorIndex
 from vearch_tpu.index.registry import register_index
+from vearch_tpu.ops import ivf as ivf_ops
 from vearch_tpu.parallel import mesh as mesh_lib
+from vearch_tpu.parallel.mesh import ShardedRowCache
 from vearch_tpu.parallel.sharded import sharded_flat_search
 
 
@@ -40,9 +42,11 @@ class ShardedFlatIndex(VectorIndex):
         n_dev = int(params.get("n_devices", 0)) or len(jax.devices())
         query_axis = int(params.get("query_axis", 1))
         self.mesh = mesh_lib.make_mesh(n_dev, query_axis=query_axis)
-        self._base = None
-        self._sqnorm = None
+        self._sh_cache = ShardedRowCache(align=128, sqnorm_of=0)
         self._placed_rows = 0
+        self._valid_src = object()  # sentinel: never matches a real mask
+        self._valid_dev = None
+        self._valid_key = (-1, -1)
 
     def _maybe_normalize(self, x: np.ndarray) -> np.ndarray:
         if self.metric is MetricType.COSINE:
@@ -50,19 +54,59 @@ class ShardedFlatIndex(VectorIndex):
             return (x / n).astype(np.float32)
         return x
 
-    def _place(self) -> None:
-        from vearch_tpu.ops.distance import sqnorms
+    def _place(self):
+        """Sharded base + derived sqnorm column, tail-appended when rows
+        merely grew within capacity. Normalization is per-row, so the
+        append window produces bit-identical rows to a full rebuild."""
+        n = self.store.count
+        d = self.store.dimension
 
-        host = self._maybe_normalize(
-            self.store.host_view().astype(np.float32)
-        ).astype(self.store.store_dtype)
-        self._base, self._n = mesh_lib.shard_rows(self.mesh, host)
-        self._sqnorm = sqnorms(self._base)
-        self._placed_rows = self.store.count
+        def build(cap):
+            host = np.zeros((cap, d), dtype=np.float32)
+            host[:n] = self._maybe_normalize(
+                self.store.host_view()[:n].astype(np.float32)
+            )
+            return (host.astype(self.store.store_dtype),)
+
+        def append(lo, hi):
+            win = np.zeros((hi - lo, d), dtype=np.float32)
+            m = min(hi, n) - lo
+            if m > 0:
+                win[:m] = self._maybe_normalize(
+                    np.asarray(
+                        self.store.host_view()[lo : lo + m], np.float32
+                    )
+                )
+            return (win.astype(self.store.store_dtype),)
+
+        (base,), _ = self._sh_cache.get(self.mesh, n, build, append)
+        self._placed_rows = n
+        return base, self._sh_cache.sqnorm
 
     def absorb(self, upto: int) -> None:
         with self._absorb_lock:
             self.indexed_count = max(self.indexed_count, upto)
+
+    def _valid_sharded(self, valid_mask, n: int, n_pad: int):
+        """Sharded alive mask, cached per mask identity (the engine
+        reuses one alive-mask object per bitmap version; the strong
+        source reference keeps the id() check sound)."""
+        if (
+            self._valid_src is valid_mask
+            and valid_mask is not None
+            and self._valid_key == (n, n_pad)
+        ):
+            return self._valid_dev
+        v = np.zeros(n_pad, dtype=bool)
+        if valid_mask is not None:
+            vm = np.asarray(valid_mask)[:n]
+            v[: vm.shape[0]] = vm
+        else:
+            v[:n] = True
+        self._valid_dev, _ = mesh_lib.shard_rows(self.mesh, v)
+        self._valid_src = valid_mask
+        self._valid_key = (n, n_pad)
+        return self._valid_dev
 
     def search(
         self,
@@ -71,29 +115,21 @@ class ShardedFlatIndex(VectorIndex):
         valid_mask,
         params: dict | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
-        if self._base is None or self._placed_rows < self.store.count:
-            self._place()
+        base, sqnorm = self._place()
         q = self._maybe_normalize(np.asarray(queries, np.float32))
         metric = (
             MetricType.INNER_PRODUCT
             if self.metric is MetricType.COSINE
             else self.metric
         )
-        # sharded validity mask: alive rows up to the placed count
-        n_pad = self._base.shape[0]
-        v = np.zeros(n_pad, dtype=bool)
-        n = min(self._placed_rows, n_pad)
-        if valid_mask is not None:
-            vm = np.asarray(valid_mask)[:n]
-            v[: vm.shape[0]] = vm
-        else:
-            v[:n] = True
-        valid_dev, _ = mesh_lib.shard_rows(self.mesh, v)
+        n = min(self._placed_rows, base.shape[0])
+        valid_dev = self._valid_sharded(valid_mask, n, base.shape[0])
         qd, b = mesh_lib.shard_queries(
             self.mesh, q.astype(self.store.store_dtype)
         )
+        ivf_ops.note_dispatch("sharded_flat_scan")
         scores, ids = sharded_flat_search(
-            self.mesh, self._base, self._sqnorm, valid_dev, qd,
+            self.mesh, base, sqnorm, valid_dev, qd,
             min(k, max(n, 1)), metric,
         )
         scores, ids = jax.device_get((scores, ids))
@@ -104,3 +140,28 @@ class ShardedFlatIndex(VectorIndex):
                             constant_values=float("-inf"))
             ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
         return scores[:, :k], ids[:, :k]
+
+    def placement_stats(self) -> dict:
+        """Rebuild/append/H2D counters of the sharded placement (perf
+        gates assert absorb never re-places the full buffer)."""
+        return dict(self._sh_cache.stats)
+
+    def mesh_info(self) -> dict | None:
+        return {
+            "devices": int(self.mesh.size),
+            "data_shards": int(self.mesh.shape["data"]),
+            "query_shards": int(self.mesh.shape["query"]),
+            "per_device_bytes": self.device_footprint_per_device_bytes(),
+            "placement": self.placement_stats(),
+        }
+
+    def device_footprint_per_device_bytes(self) -> int:
+        from vearch_tpu.ops import perf_model
+
+        cap = self._sh_cache.capacity(self.mesh, self.store.count)
+        sharded = perf_model.raw_store_footprint_bytes(
+            cap, self.store.dimension, self.store.store_dtype.itemsize
+        )
+        return perf_model.per_device_bytes(
+            sharded, 0, int(self.mesh.shape["data"])
+        )

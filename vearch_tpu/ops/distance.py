@@ -39,6 +39,18 @@ def sqnorms(x: jax.Array) -> jax.Array:
     return jnp.sum(xf * xf, axis=-1)
 
 
+def host_sqnorms(x: np.ndarray) -> np.ndarray:
+    """Host-side sqnorms for DERIVED device columns (raw-base sqnorm).
+    numpy's fixed-length inner-axis pairwise sum is deterministic, so
+    every placement path — full place, single-device tail flush, mesh
+    shard rebuild, mesh tail-append — lands the bit-identical column;
+    XLA reductions reassociate per program shape and would drift by an
+    ulp between paths (the int8 mirror's _h_vsq follows the same
+    host-derived design)."""
+    xf = np.asarray(x).astype(np.float32)
+    return np.sum(xf * xf, axis=-1)
+
+
 def dot_precision(*arrays: jax.Array):
     """Pick matmul precision by input dtype.
 

@@ -68,13 +68,17 @@ _capture_tls = threading.local()
 
 
 class DispatchCapture:
-    __slots__ = ("events",)
+    __slots__ = ("events", "mesh_phases")
 
     def __init__(self) -> None:
         # [tag, start_monotonic_s, end_monotonic_s | None] — consumers
         # (engine._record_dispatch_trace) anchor to the epoch via
         # utils.mono_us when emitting spans
         self.events: list[list] = []
+        # (name, start_monotonic_s, end_monotonic_s) host-side windows
+        # of the mesh serving path (shard placement, mask upload, ...)
+        # — replayed by the engine as mesh.{name} phase spans
+        self.mesh_phases: list[tuple[str, float, float]] = []
 
     def note(self, tag: str) -> None:
         now = time.monotonic()
@@ -119,6 +123,15 @@ def note_dispatch(tag: str) -> None:
     cap = getattr(_capture_tls, "capture", None)
     if cap is not None:
         cap.note(tag)
+
+
+def note_mesh_phase(name: str, t0: float, t1: float) -> None:
+    """Record a host-side window of the mesh serving path (per-shard
+    placement, mask upload) on the current request's capture — shows up
+    as a mesh.{name} phase span next to the kernel.* dispatch spans."""
+    cap = getattr(_capture_tls, "capture", None)
+    if cap is not None:
+        cap.mesh_phases.append((name, t0, t1))
 
 
 def _coarse_probes(

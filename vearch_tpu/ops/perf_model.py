@@ -116,6 +116,15 @@ DOCUMENTED_DISPATCHES: dict[str, list[str]] = {
     # is ZERO device programs — the cache perf gates assert an empty
     # ledger for hits and exactly one documented set per coalesced group
     "cache_hit": [],
+    # mesh serving (parallel/sharded.py): probe gate + shard scan +
+    # all_gather merge + exact rerank + pmax merge, ONE shard_map program
+    "ivfpq_mesh_fused": ["sharded_fused_scan_rerank"],
+    # mesh serving with fused_rerank=false (A/B escape hatch)
+    "ivfpq_mesh_unfused": ["sharded_scan", "sharded_rerank"],
+    # mesh serving with exact rerank disabled: scan+merge only
+    "ivfpq_mesh_scan": ["sharded_scan"],
+    # FLAT over the mesh: one fused scan+all_gather+re-top-k program
+    "flat_sharded": ["sharded_flat_scan"],
 }
 
 
@@ -229,6 +238,16 @@ def raw_store_footprint_bytes(
 ) -> int:
     """Raw device buffer + sqnorm column (engine/raw_vector.py)."""
     return capacity * d * itemsize + capacity * F32
+
+
+def per_device_bytes(
+    sharded_bytes: int, replicated_bytes: int, n_shards: int
+) -> int:
+    """Resident HBM on EACH chip of a mesh placement: row-sharded state
+    divides across the "data" axis (ceil: padded slabs), replicated
+    state (coarse centroids, bucket tensors) rides whole on every chip.
+    With n_shards == 1 this degenerates to the single-device footprint."""
+    return replicated_bytes + -(-sharded_bytes // max(n_shards, 1))
 
 
 def ivf_bucket_footprint_bytes(nlist: int, cap: int, d: int) -> int:

@@ -16,23 +16,23 @@ sharded ("query" axis). Collectives ride ICI:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-_default_mesh: Mesh | None = None
-
-
 def default_mesh() -> Mesh:
     """Process-wide all-devices mesh, rows on "data" (cached: mesh
     identity matters for jit cache hits)."""
-    global _default_mesh
-    if _default_mesh is None or (
-        _default_mesh.size != len(jax.devices())
-    ):
-        _default_mesh = make_mesh(query_axis=1)
-    return _default_mesh
+    return make_mesh(query_axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_cached(n: int, data_axis: int, query_axis: int) -> Mesh:
+    dev_array = np.asarray(jax.devices()[:n]).reshape(data_axis, query_axis)
+    return Mesh(dev_array, axis_names=("data", "query"))
 
 
 def make_mesh(
@@ -44,16 +44,19 @@ def make_mesh(
 
     Default puts all devices on "data" (row sharding) — the right shape
     for search serving where the DB dwarfs the query batch.
+
+    Meshes are cached per (n, data_axis, query_axis): the shard_map
+    program builders in parallel/sharded.py key their lru_caches on mesh
+    IDENTITY, so a fresh Mesh per engine publish would retrace every
+    sharded program and blow past the zero-new-programs perf gates.
     """
-    devices = jax.devices()[: (n_devices or len(jax.devices()))]
-    n = len(devices)
+    n = min(n_devices or len(jax.devices()), len(jax.devices()))
     if data_axis is None:
         data_axis = n // query_axis
     assert data_axis * query_axis == n, (
         f"mesh {data_axis}x{query_axis} != {n} devices"
     )
-    dev_array = np.asarray(devices).reshape(data_axis, query_axis)
-    return Mesh(dev_array, axis_names=("data", "query"))
+    return _mesh_cached(n, data_axis, query_axis)
 
 
 def shard_rows(mesh: Mesh, x, pad_value=0):
@@ -95,40 +98,172 @@ def replicate(mesh: Mesh, x):
     return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
 
 
+@functools.lru_cache(maxsize=16)
+def _tail_update_fn(ndim: int, with_sqnorm: bool):
+    """Per-device tail writer: dynamic_update_slice of the new rows into
+    one shard's slab (NOT donated — a concurrent search may still hold
+    the previous buffer; the device-side copy is the price of lock-free
+    reads). Traced `off` so append offsets never retrace. The derived
+    sqnorm tail arrives pre-computed host-side (ops/distance
+    host_sqnorms) so every placement path lands the identical column."""
+    from vearch_tpu.ops.perf_model import register_jit
+
+    def upd(dst, tail, off, sq=None, sq_tail=None):
+        idx = (off,) + (0,) * (ndim - 1)
+        out = jax.lax.dynamic_update_slice(dst, tail, idx)
+        if sq is None:
+            return out
+        return out, jax.lax.dynamic_update_slice(sq, sq_tail, (off,))
+
+    fn = jax.jit(upd)
+    return register_jit(
+        f"mesh.tail_append[{ndim}d{',sqnorm' if with_sqnorm else ''}]", fn
+    )
+
+
 class ShardedRowCache:
     """Grow-only cache of host row arrays placed row-sharded on a mesh.
 
     One invalidation point for every sharded device buffer (int8 mirror,
-    raw rerank base, ...): `get` rebuilds when capacity changed or rows
-    grew past the cached high-water mark; `lower_rows` must be called
-    when rows BELOW the high-water mark were overwritten (re-absorb,
-    engine load) so the next get re-places instead of serving stale
-    rows; `invalidate` drops everything.
+    raw rerank base, ...): `get` rebuilds when capacity changed, and
+    TAIL-APPENDS when rows merely grew within the cached capacity and
+    the caller supplies `append_host_fn` — one H2D per touched device of
+    only the new rows, never a full re-place (realtime absorb on a mesh
+    partition). `lower_rows` must be called when rows BELOW the
+    high-water mark were overwritten (re-absorb, engine load) so the
+    next get re-places instead of serving stale rows; `invalidate` drops
+    everything.
+
+    `sqnorm_of=i` maintains a derived [cap] f32 squared-norm column of
+    arrays[i] (`self.sqnorm`), kept in lockstep through both rebuilds
+    and tail-appends — the rerank base needs it and computing it host-
+    side would break bit-equality with the single-device path.
+
+    `stats` counts rebuilds / appends / H2D bytes so the perf gates can
+    assert absorb never re-places the full buffer.
     """
 
-    def __init__(self, align: int):
+    def __init__(self, align: int, sqnorm_of: int | None = None):
         self.align = align
+        self.sqnorm_of = sqnorm_of
         self._key = None
         self._rows = 0
         self.arrays: tuple | None = None
+        self.sqnorm: jax.Array | None = None
+        self.stats = {"rebuilds": 0, "appends": 0, "h2d_bytes": 0}
 
     def capacity(self, mesh: Mesh, n: int) -> int:
+        """Sharded capacity for n rows: align*n_shards units, grown
+        GEOMETRICALLY past the currently-placed capacity so realtime
+        absorb amortizes to tail-appends (a tight capacity would force
+        a full re-place every time n crossed a unit boundary)."""
         unit = self.align * mesh.shape["data"]
-        return -(-max(n, 1) // unit) * unit
+        need = -(-max(n, 1) // unit) * unit
+        if self._key is not None and self._key[0] == id(mesh):
+            cur = self._key[1]
+            if cur >= need:
+                return cur
+            return max(need, 2 * cur)
+        return need
 
-    def get(self, mesh: Mesh, n: int, build_host_fn):
-        """build_host_fn(cap) -> tuple of host arrays with cap rows.
-        Returns (device_arrays, rebuilt)."""
+    def get(self, mesh: Mesh, n: int, build_host_fn, append_host_fn=None):
+        """build_host_fn(cap) -> tuple of host arrays with cap rows;
+        append_host_fn(lo, hi) -> tuple of host arrays with hi-lo rows
+        (rows [lo, hi) of each cached array). Returns (device_arrays,
+        rebuilt)."""
         cap = self.capacity(mesh, n)
         key = (id(mesh), cap)
         rebuilt = False
-        if self._key != key or self._rows < n or self.arrays is None:
+        if self._key == key and self.arrays is not None and self._rows < n \
+                and append_host_fn is not None:
+            self._append(mesh, n, cap, append_host_fn)
+        elif self._key != key or self._rows < n or self.arrays is None:
             hosts = build_host_fn(cap)
             self.arrays = tuple(shard_rows(mesh, h)[0] for h in hosts)
+            if self.sqnorm_of is not None:
+                from vearch_tpu.ops.distance import host_sqnorms
+
+                self.sqnorm = shard_rows(
+                    mesh, host_sqnorms(hosts[self.sqnorm_of])
+                )[0]
             self._key = key
             self._rows = n
             rebuilt = True
+            self.stats["rebuilds"] += 1
+            self.stats["h2d_bytes"] += sum(
+                np.asarray(h).nbytes for h in hosts
+            )
         return self.arrays, rebuilt
+
+    def _append(self, mesh: Mesh, n: int, cap: int, append_host_fn) -> None:
+        """Tail-append rows [rows_hw, n) in place: the host window is
+        align-rounded so every per-shard slice keeps lane-aligned static
+        shapes (bounded retrace), sliced per shard, H2D'd to exactly the
+        devices whose slab the window touches, and written with a
+        non-donating dynamic_update_slice. Untouched shards keep their
+        existing buffers — zero copies, zero traffic."""
+        n_shards = mesh.shape["data"]
+        local_n = cap // n_shards
+        lo = (self._rows // self.align) * self.align
+        hi = min(-(-n // self.align) * self.align, cap)
+        tails = [np.asarray(t) for t in append_host_fn(lo, hi)]
+        sq_tail = None
+        if self.sqnorm_of is not None:
+            from vearch_tpu.ops.distance import host_sqnorms
+
+            sq_tail = host_sqnorms(tails[self.sqnorm_of])
+        new_arrays = []
+        new_sq = self.sqnorm
+        for ai, arr in enumerate(self.arrays):
+            want_sq = self.sqnorm_of == ai
+            upd = _tail_update_fn(arr.ndim, want_sq)
+            parts = {}
+            sq_parts = {}
+            for sh in arr.addressable_shards:
+                s = (sh.index[0].start or 0) // local_n
+                a = max(lo, s * local_n)
+                b = min(hi, (s + 1) * local_n)
+                if a >= b:
+                    parts[s] = sh.data
+                    continue
+                win = tails[ai][a - lo : b - lo]
+                win_dev = jax.device_put(win, sh.device)
+                self.stats["h2d_bytes"] += win.nbytes
+                off = np.int32(a - s * local_n)
+                if want_sq:
+                    sq_sh = {
+                        (q.index[0].start or 0) // local_n: q
+                        for q in new_sq.addressable_shards
+                    }[s]
+                    sq_win = jax.device_put(
+                        sq_tail[a - lo : b - lo], sh.device
+                    )
+                    self.stats["h2d_bytes"] += sq_win.nbytes
+                    parts[s], sq_parts[s] = upd(
+                        sh.data, win_dev, off, sq_sh.data, sq_win
+                    )
+                else:
+                    parts[s] = upd(sh.data, win_dev, off)
+            new_arrays.append(jax.make_array_from_single_device_arrays(
+                arr.shape, arr.sharding,
+                [parts[s] for s in sorted(parts)],
+            ))
+            if want_sq:
+                sq_all = {
+                    (q.index[0].start or 0) // local_n: q.data
+                    for q in new_sq.addressable_shards
+                }
+                sq_all.update(sq_parts)
+                new_sq = jax.make_array_from_single_device_arrays(
+                    new_sq.shape, new_sq.sharding,
+                    [sq_all[s] for s in sorted(sq_all)],
+                )
+        # publish by reference swap: readers see either the old or the
+        # new tuple, both internally consistent
+        self.arrays = tuple(new_arrays)
+        self.sqnorm = new_sq
+        self._rows = n
+        self.stats["appends"] += 1
 
     def lower_rows(self, start: int) -> None:
         self._rows = min(self._rows, start)
@@ -137,3 +272,4 @@ class ShardedRowCache:
         self._key = None
         self._rows = 0
         self.arrays = None
+        self.sqnorm = None

@@ -24,9 +24,14 @@ from jax.experimental.shard_map import shard_map
 from vearch_tpu.engine.types import MetricType
 from vearch_tpu.ops import kmeans as km
 from vearch_tpu.ops.distance import brute_force_search, dot_precision, sqnorms
+from vearch_tpu.ops.perf_model import register_jit
 from vearch_tpu.parallel import mesh as mesh_lib
 
 NEG_INF = float("-inf")
+
+
+def _mesh_tag(mesh: Mesh) -> str:
+    return f"{mesh.shape['data']}x{mesh.shape['query']}"
 
 
 @functools.lru_cache(maxsize=128)
@@ -54,7 +59,9 @@ def _flat_search_fn(mesh: Mesh, k: int, metric: MetricType):
         top_s, pos = jax.lax.top_k(all_s, kk)
         return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
-    return run
+    return register_jit(
+        f"sharded.flat[{_mesh_tag(mesh)},k{k},{metric.name}]", run
+    )
 
 
 def sharded_flat_search(
@@ -123,7 +130,10 @@ def _int8_search_fn(mesh: Mesh, r: int, metric: MetricType,
         top_s, pos = jax.lax.top_k(all_s, rr)
         return top_s, jnp.take_along_axis(all_i, pos, axis=1)
 
-    return run
+    return register_jit(
+        f"sharded.int8[{_mesh_tag(mesh)},r{r},{metric.name},"
+        f"{topk_mode},{storage}]", run,
+    )
 
 
 def sharded_exact_rerank(
@@ -183,7 +193,146 @@ def _exact_rerank_fn(mesh: Mesh, k: int, metric: MetricType):
         ids = jnp.take_along_axis(cids, pos, axis=1)
         return top_s, jnp.where(jnp.isfinite(top_s), ids, -1)
 
-    return run
+    return register_jit(
+        f"sharded.rerank[{_mesh_tag(mesh)},k{k},{metric.name}]", run
+    )
+
+
+def sharded_ivf_search(
+    mesh: Mesh,
+    centroids: jax.Array | None,  # [nlist, d] f32 replicated (None: no probe)
+    assign: jax.Array | None,     # [N_pad] i32 row->cluster, sharded P("data")
+    approx8: jax.Array,           # [N_pad, d] int8 / [N_pad, d/2] packed int4
+    row_scale: jax.Array,         # [N_pad] f32 sharded P("data")
+    row_vsq: jax.Array,           # [N_pad] f32 sharded P("data")
+    valid: jax.Array,             # [N_pad] bool sharded P("data")
+    base: jax.Array,              # [cap, d] raw rows sharded P("data", None)
+    base_sqnorm: jax.Array,       # [cap] f32 sharded P("data")
+    queries: jax.Array,           # [B, d] f32 replicated
+    r: int,
+    k: int,
+    scan_metric: MetricType = MetricType.L2,
+    rerank_metric: MetricType = MetricType.L2,
+    topk_mode: str = "auto",
+    storage: str = "int8",
+    nprobe: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """The pod-slice IVF serving program: coarse probe -> per-shard
+    compressed scan -> all_gather top-r merge -> exact rerank against
+    the sharded raw base -> pmax merge + final top-k, as ONE jitted
+    shard_map program. Nothing touches the host between the query
+    replicate and the final [B, k] device_get.
+
+    nprobe=0 disables the coarse gate (docid-ordered full scan — the
+    IVFPQ "full" mode); nprobe>0 masks every shard's rows to the probed
+    cells using the REPLICATED coarse quantizer, so probe selection is
+    computed redundantly per shard instead of paying a collective."""
+    fn = _ivf_search_fn(
+        mesh, r, k, scan_metric, rerank_metric, topk_mode, storage, nprobe
+    )
+    if nprobe > 0:
+        return fn(centroids, assign, approx8, row_scale, row_vsq, valid,
+                  base, base_sqnorm, queries)
+    return fn(approx8, row_scale, row_vsq, valid, base, base_sqnorm, queries)
+
+
+@functools.lru_cache(maxsize=128)
+def _ivf_search_fn(
+    mesh: Mesh, r: int, k: int, scan_metric: MetricType,
+    rerank_metric: MetricType, topk_mode: str, storage: str, nprobe: int,
+):
+    from vearch_tpu.ops.ivf import _coarse_probes, _select_topk, unpack_int4
+
+    probed = nprobe > 0
+    mirror_specs = (P("data", None), P("data"), P("data"), P("data"))
+    rerank_specs = (P("data", None), P("data"), P(None, None))
+    if probed:
+        in_specs = (P(None, None), P("data")) + mirror_specs + rerank_specs
+    else:
+        in_specs = mirror_specs + rerank_specs
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(None, None), P(None, None)),
+        check_rep=False,
+    )
+    def run(*args):
+        if probed:
+            cents, assign, a8, sc, vsq, v, b, bsqn, q = args
+        else:
+            a8, sc, vsq, v, b, bsqn, q = args
+        local_n = sc.shape[0]
+        ok = v[None, :]
+        if probed:
+            # every shard holds the full coarse quantizer, so probe
+            # selection is recomputed identically per shard — cheaper
+            # than a collective for any realistic nlist. The per-row
+            # gate is a [B, nlist] cell mask gathered by the shard's own
+            # row->cluster assignment.
+            probes = _coarse_probes(q, cents, min(nprobe, cents.shape[0]))
+            cell = jnp.zeros(
+                (q.shape[0], cents.shape[0]), dtype=bool
+            ).at[jnp.arange(q.shape[0])[:, None], probes].set(True)
+            ok = ok & cell[:, jnp.maximum(assign, 0)]
+        rows = a8.astype(jnp.bfloat16) if storage == "int8" \
+            else unpack_int4(a8)
+        dots = jax.lax.dot_general(
+            q.astype(jnp.bfloat16), rows, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sc[None, :]
+        if scan_metric is MetricType.L2:
+            scores = -(sqnorms(q)[:, None] - 2.0 * dots + vsq[None, :])
+        else:
+            scores = dots
+        scores = jnp.where(ok, scores, NEG_INF)
+        top_s, top_i = _select_topk(scores, min(r, local_n), topk_mode)
+        shard = jax.lax.axis_index("data")
+        gids = jnp.where(top_i >= 0, top_i + shard * local_n, -1)
+        all_s = jax.lax.all_gather(top_s, "data", axis=1, tiled=True)
+        all_i = jax.lax.all_gather(gids, "data", axis=1, tiled=True)
+        rr = min(r, all_s.shape[1])
+        cand_s, pos = jax.lax.top_k(all_s, rr)
+        cand_i = jnp.take_along_axis(all_i, pos, axis=1)
+        # exact rerank against the shard's raw slab: candidates this
+        # shard does not own score -inf and the pmax merge recovers the
+        # owner's exact score everywhere (same ownership math as
+        # _exact_rerank_fn, with the BASE slab size — the mirror and the
+        # raw buffer are padded to different alignments)
+        local_nb = b.shape[0]
+        local = cand_i - shard * local_nb
+        mine = (cand_i >= 0) & (local >= 0) & (local < local_nb)
+        safe = jnp.clip(local, 0, local_nb - 1)
+        vecs = b[safe]  # [B, rr, d]
+        bvsq = bsqn[safe]
+        qf = q.astype(b.dtype)
+        rdots = jax.lax.dot_general(
+            qf, vecs, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=dot_precision(qf, vecs),
+        )
+        if rerank_metric is MetricType.L2:
+            rscores = -(sqnorms(qf)[:, None] - 2.0 * rdots + bvsq)
+        elif rerank_metric is MetricType.COSINE:
+            qn = jnp.sqrt(jnp.maximum(sqnorms(qf), 1e-30))[:, None]
+            vn = jnp.sqrt(jnp.maximum(bvsq, 1e-30))
+            rscores = rdots / (qn * vn)
+        else:
+            rscores = rdots
+        rscores = jnp.where(mine, rscores, NEG_INF)
+        rscores = jax.lax.pmax(rscores, "data")
+        kk = min(k, rscores.shape[1])
+        out_s, out_pos = jax.lax.top_k(rscores, kk)
+        out_i = jnp.take_along_axis(cand_i, out_pos, axis=1)
+        return out_s, jnp.where(jnp.isfinite(out_s), out_i, -1)
+
+    return register_jit(
+        f"sharded.ivf_fused[{_mesh_tag(mesh)},r{r},k{k},"
+        f"{scan_metric.name},{rerank_metric.name},{topk_mode},{storage},"
+        f"p{nprobe}]", run,
+    )
 
 
 def sharded_kmeans_step(

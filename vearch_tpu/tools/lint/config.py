@@ -14,6 +14,10 @@ from __future__ import annotations
 DISPATCH_PACKAGES = (
     "vearch_tpu/ops/",
     "vearch_tpu/engine/",
+    # the mesh data plane: shard_map programs + tail-append writers are
+    # first-class dispatch sources, registered in the perf model's jit
+    # registry like every ops/ program
+    "vearch_tpu/parallel/",
 )
 
 # Names whose call or decorator use counts as creating a dispatchable
