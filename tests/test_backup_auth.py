@@ -40,6 +40,14 @@ def test_backup_create_list_restore(tmp_path, rng):
         hits = cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
                          limit=1)
         assert hits[0] == []
+        # repeat the search so the post-delete (empty) answer sits WARM
+        # in the router's merged-result cache before the restore runs —
+        # the regression this test gates is that restore left such
+        # entries "valid" (apply versions unchanged) serving stale
+        # emptiness afterwards
+        hits = cl.search("db", "s", [{"field": "v", "feature": vecs[3]}],
+                         limit=1)
+        assert hits[0] == []
 
         versions = rpc.call(c.master_addr, "POST", "/backup/dbs/db/spaces/s",
                             {"command": "list", "store_root": store_root})

@@ -1,0 +1,360 @@
+"""Runtime truth layer: device telemetry drift gate, compile-audit
+flight recorder, streaming tail quantiles, and the cluster doctor.
+
+The perf model PREDICTS footprints and the perf gates assert compile
+behavior in CI; this suite asserts the runtime itself is being
+measured in a live cluster and that lying models / post-warmup
+compiles surface as first-class health signals:
+
+- drift gate: measured HBM within model tolerance on a healthy
+  cluster; an injected untracked allocation flips the drift gauge and
+  degrades /cluster/health;
+- compile audit: a warmed cluster serves repeats with a flat compile
+  counter; a forced novel-shape request produces exactly one
+  /debug/compiles event carrying the originating trace id;
+- quantiles: P2 sketches feed per-op latency quantile gauges on the
+  PS and a merged view at /router/stats;
+- doctor: exit 0 against the healthy 2-node cluster, exit 1 with the
+  violation named once one is injected.
+"""
+
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def scrape(addr: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def gauge_value(text: str, name: str, **labels) -> float | None:
+    want = {k: str(v) for k, v in labels.items()}
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        m = re.match(rf"{name}(?:{{(.*)}})? ([-0-9.e+]+)$", line)
+        if not m:
+            continue
+        got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1) or ""))
+        if got == want:
+            return float(m.group(2))
+    return None
+
+
+def _poll(cond, timeout_s: float, interval_s: float = 0.2):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if cond():
+            return True
+        if time.monotonic() >= deadline:
+            return cond()
+        time.sleep(interval_s)
+
+
+def _seed_space(cluster, partitions: int = 2, docs: int = 60):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": partitions, "replica_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((docs, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(docs)])
+    return cl, vecs
+
+
+def _warm(cluster, cl, vecs, n: int = 3):
+    """Prime the serving shapes, then declare 'warmed now' the way an
+    operator does: reset every PS flight recorder so what follows is
+    measured against a clean post-warmup baseline."""
+    for _ in range(n):
+        cl.search("db", "s", [{"field": "v", "feature": vecs[0]}],
+                  limit=3)
+    for ps in cluster.ps_nodes:
+        rpc.call(ps.addr, "POST", "/debug/compiles/reset")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    # tight drift knobs: zero tolerance, 8 MiB slack, and a background
+    # interval long enough that only explicit sample_now() calls run —
+    # the tests control exactly when the runtime is measured
+    c = StandaloneCluster(data_dir=str(tmp_path / "obs"), n_ps=2,
+                          ps_kwargs={
+                              "device_sample_interval": 60.0,
+                              "hbm_drift_tolerance": 0.0,
+                              "hbm_drift_slack_mb": 8,
+                          })
+    c.start()
+    yield c
+    c.stop()
+
+
+# -- drift gate --------------------------------------------------------------
+
+
+def test_hbm_drift_gate_flips_gauge_and_health(cluster):
+    cl, vecs = _seed_space(cluster)
+    cl.search("db", "s", [{"field": "v", "feature": vecs[1]}], limit=3)
+
+    # healthy: measured HBM within model + baseline on every node, the
+    # drift gauge renders 0, and the rollup carries no drift nodes
+    for ps in cluster.ps_nodes:
+        snap = ps.device_sampler.sample_now()
+        assert snap["samples"] >= 1
+        assert snap["devices"], "sampler saw no devices"
+        assert not snap["drift"], snap
+        text = scrape(ps.addr)
+        assert gauge_value(text, "vearch_ps_hbm_model_drift") == 0.0
+        # device gauge renders a real per-device byte count
+        dev = next(iter(snap["devices"]))
+        assert (gauge_value(text, "vearch_ps_device_hbm_live_bytes",
+                            device=dev) or 0.0) > 0.0
+    health = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+    assert health.get("hbm_drift_nodes") == []
+
+    # inject an allocation the footprint model knows nothing about:
+    # 32 MiB of live device buffer, held so it stays in live_arrays
+    import jax.numpy as jnp
+
+    blob = jnp.ones((8 << 20,), jnp.float32)
+    blob.block_until_ready()
+    try:
+        ps0 = cluster.ps_nodes[0]
+        snap = ps0.device_sampler.sample_now()
+        assert snap["drift"], snap
+        assert snap["drift_bytes"] >= (24 << 20), snap["drift_bytes"]
+        text = scrape(ps0.addr)
+        assert gauge_value(text, "vearch_ps_hbm_model_drift") == 1.0
+        assert gauge_value(
+            text, "vearch_ps_hbm_model_drift_bytes") >= (24 << 20)
+
+        # the flag rides the heartbeat into the master and degrades the
+        # health rollup — no polling of the PS by the master
+        def degraded():
+            h = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+            return (ps0.node_id in (h.get("hbm_drift_nodes") or [])
+                    and h.get("status") in ("yellow", "red"))
+
+        assert _poll(degraded, 15.0), rpc.call(
+            cluster.master_addr, "GET", "/cluster/health")
+    finally:
+        del blob
+    # dropping the allocation clears the drift on the next sample
+    snap = cluster.ps_nodes[0].device_sampler.sample_now()
+    assert not snap["drift"], snap
+
+
+def test_h2d_and_compiled_program_gauges_render(cluster):
+    _seed_space(cluster)
+    text = scrape(cluster.ps_nodes[0].addr)
+    # uploads happened during seeding: the transfer accumulator moved
+    assert (gauge_value(text, "vearch_ps_h2d_bytes_total") or 0.0) > 0.0
+    assert (gauge_value(text, "vearch_ps_compiled_programs")
+            or 0.0) > 0.0
+
+
+# -- compile audit -----------------------------------------------------------
+
+
+def test_warmed_cluster_serves_repeats_with_flat_compile_counter(cluster):
+    cl, vecs = _seed_space(cluster)
+    _warm(cluster, cl, vecs)
+    for i in range(5):
+        cl.search("db", "s", [{"field": "v", "feature": vecs[i]}],
+                  limit=3)
+    for ps in cluster.ps_nodes:
+        comp = rpc.call(ps.addr, "GET", "/debug/compiles")
+        assert comp["total"] == 0, comp
+        assert comp["events"] == []
+        # the counter never minted a series either
+        assert "vearch_serving_compiles_total{" not in scrape(ps.addr)
+
+
+def test_novel_shape_request_records_one_event_with_trace_id(cluster):
+    cl, vecs = _seed_space(cluster)
+    _warm(cluster, cl, vecs)
+
+    # limit is a static arg of the top-k program: an unseen value
+    # forces XLA to compile a new specialisation on the serving path
+    out = rpc.call(cluster.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s", "limit": 7, "trace": True,
+        "vectors": [{"field": "v", "feature": vecs[9].tolist()}],
+    })
+    assert out.get("trace_id")
+
+    comp = rpc.call(cluster.ps_nodes[0].addr, "GET", "/debug/compiles")
+    assert comp["total"] == 1, comp
+    assert len(comp["events"]) == 1
+    ev = comp["events"][0]
+    # the event names the program, the shape cause, and the request
+    assert ev["path"] == "distance.brute_force_search"
+    assert "|7|" in ev["shapes"], ev["shapes"]
+    assert ev["trace_id"] == out["trace_id"]
+    assert ev["elapsed_ms"] > 0
+
+    # ... and the counter minted exactly the one series
+    text = scrape(cluster.ps_nodes[0].addr)
+    assert gauge_value(text, "vearch_serving_compiles_total",
+                       path="distance.brute_force_search") == 1.0
+
+    # a REPEAT of the now-compiled shape adds nothing (dedupe + jit hit)
+    rpc.call(cluster.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s", "limit": 7,
+        "vectors": [{"field": "v", "feature": vecs[9].tolist()}],
+    })
+    comp = rpc.call(cluster.ps_nodes[0].addr, "GET", "/debug/compiles")
+    assert comp["total"] == 1
+
+    # the digest rides the heartbeat into the master rollup
+    def counted():
+        h = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+        return (h.get("serving_compiles") or 0) >= 1
+
+    assert _poll(counted, 15.0)
+
+
+def test_warmup_scopes_suppress_expected_compiles(cluster):
+    """Engine open/build/create paths compile — those are expected and
+    must land in the warmup counter, never the post-warmup ring."""
+    _seed_space(cluster)
+    for ps in cluster.ps_nodes:
+        comp = rpc.call(ps.addr, "GET", "/debug/compiles")
+        # partition create + first dump ran under warmup scopes
+        assert comp["warmup_compiles"] >= 0
+        for ev in comp["events"]:
+            # nothing in the ring is from an engine-lifecycle path:
+            # every recorded event is a serving-path program
+            assert ev["path"].split(".")[0] in (
+                "distance", "ivf", "fused", "mesh", "sharded",
+            ), ev
+
+
+# -- streaming tail quantiles ------------------------------------------------
+
+
+def test_latency_quantile_gauges_and_router_merge(cluster):
+    cl, vecs = _seed_space(cluster)
+    for i in range(30):
+        cl.search("db", "s",
+                  [{"field": "v", "feature": vecs[i % len(vecs)]}],
+                  limit=3, cache=False)
+
+    ps_text = scrape(cluster.ps_nodes[0].addr)
+    # full fixed label set renders from the very first scrape,
+    # observed or not ...
+    for op in ("search", "write"):
+        for q in ("0.5", "0.95", "0.99"):
+            assert gauge_value(ps_text, "vearch_ps_latency_quantile",
+                               op=op, q=q) is not None, (op, q)
+    # ... and the searched op carries real measurements in order
+    q50 = gauge_value(ps_text, "vearch_ps_latency_quantile",
+                      op="search", q="0.5")
+    q99 = gauge_value(ps_text, "vearch_ps_latency_quantile",
+                      op="search", q="0.99")
+    assert q50 > 0.0
+    assert q99 >= q50
+
+    # per-partition sketches surface in /ps/stats
+    stats = rpc.call(cluster.ps_nodes[0].addr, "GET", "/ps/stats")
+    lq = stats.get("latency_quantiles") or {}
+    search_keys = [k for k in lq if k.endswith("/search")]
+    assert search_keys, lq
+    rec = lq[search_keys[0]]
+    assert rec["count"] > 0
+    assert set(rec["q"]) == {"0.5", "0.95", "0.99"}
+
+    # router-side merged view: scatter quantiles per partition + node
+    rstats = rpc.call(cluster.router_addr, "GET", "/router/stats")
+    rlq = rstats.get("latency_quantiles") or {}
+    assert any(k.endswith("/scatter") for k in rlq), rlq
+    node = rlq.get("_node/scatter")
+    assert node and node["count"] > 0
+    rtext = scrape(cluster.router_addr)
+    assert gauge_value(rtext, "vearch_router_latency_quantile",
+                       op="scatter", q="0.95") is not None
+
+
+def test_queue_depth_and_inflight_gauges_render_full_label_set(cluster):
+    _seed_space(cluster)
+    text = scrape(cluster.ps_nodes[0].addr)
+    for op in ("search", "write"):
+        assert gauge_value(text, "vearch_ps_queue_depth",
+                           op=op) is not None, op
+        assert gauge_value(text, "vearch_ps_inflight",
+                           op=op) is not None, op
+    # idle cluster: nothing waiting, nothing executing
+    assert gauge_value(text, "vearch_ps_queue_depth", op="search") == 0.0
+    assert gauge_value(text, "vearch_ps_inflight", op="search") == 0.0
+
+
+# -- cluster doctor ----------------------------------------------------------
+
+
+def test_doctor_green_on_healthy_cluster_then_flags_violation(cluster):
+    from vearch_tpu.obs import doctor
+
+    cl, vecs = _seed_space(cluster)
+    _warm(cluster, cl, vecs)
+
+    report, code = doctor.run(cluster.master_addr)
+    assert code == 0, doctor.format_report(report)
+    names = {c["name"] for c in report["checks"]}
+    assert {"hbm_drift", "post_warmup_compiles", "cardinality_ceiling",
+            "cluster_health", "obs_docs"} <= names
+    assert report["violations"] == []
+    # evidence made it into the report: both PS visited, series counted
+    assert len(report["servers"]) == 2
+    for srv in report["servers"]:
+        assert srv["metrics_series"] is not None
+        assert "partitions" in (srv["stats"] or {})
+    # the human summary names every check
+    summary = doctor.format_report(report)
+    assert "all checks passed" in summary
+
+    # inject a violation: force a post-warmup serving compile
+    rpc.call(cluster.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s", "limit": 11,
+        "vectors": [{"field": "v", "feature": vecs[2].tolist()}],
+    })
+    report, code = doctor.run(cluster.master_addr)
+    assert code == 1
+    violated = {v["name"] for v in report["violations"]}
+    assert "post_warmup_compiles" in violated, report["violations"]
+    assert "post_warmup_compiles" in doctor.format_report(report)
+
+
+def test_doctor_cli_verb(cluster, capsys):
+    """`python -m vearch_tpu doctor` — the operator entry point."""
+    from vearch_tpu.__main__ import main as tpu_main
+
+    cl, vecs = _seed_space(cluster)
+    _warm(cluster, cl, vecs)
+    code = tpu_main(["doctor", "--master", cluster.master_addr])
+    out = capsys.readouterr().out
+    assert code == 0, out
+    assert "all checks passed" in out
+
+    # JSON mode emits the machine-readable report
+    import json
+
+    code = tpu_main(["doctor", "--master", cluster.master_addr,
+                     "--json"])
+    out = capsys.readouterr().out
+    assert code == 0
+    report = json.loads(out)
+    assert report["checks"]

@@ -42,6 +42,25 @@ N_NODES = 3
 N_SCHEDULES = 100
 
 
+def _poll(cond, timeout_s: float, interval_s: float = 0.02,
+          on_tick=None) -> bool:
+    """Condition-poll on the monotonic clock. Wall-clock deadlines
+    (time.time()) jump under NTP and, worse, full-suite scheduler
+    stalls burn the budget while nothing protocol-related advances —
+    the historical source of the 'no leader converged after heal'
+    flakes. Polling a condition monotonically keeps every wait bounded
+    AND exits the moment the condition holds."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if on_tick is not None:
+            on_tick()
+        if cond():
+            return True
+        if time.monotonic() >= deadline:
+            return cond()
+        time.sleep(interval_s)
+
+
 class FaultyNet:
     """Message fabric with seeded faults. All inter-node AND
     master->node traffic rides through send(), so fences and appends
@@ -285,25 +304,37 @@ def _run_schedule(tmp_path, seed: int) -> None:
 
     # -- convergence: heal, reconcile until a leader exists, marker op --
     net.heal()
-    deadline = time.time() + 20.0
     marker = {"seed": seed, "marker": True}
-    while time.time() < deadline:
+    marked: list[bool] = []
+
+    def _try_marker() -> bool:
+        if marked:
+            return True
         try:
             cluster.propose(cluster.leader, marker)
-            break
+            marked.append(True)
+            return True
         except RpcError:
             cluster.reconfigure()
-            time.sleep(0.01)
-    else:
+            return False
+
+    if not _poll(_try_marker, 30.0, 0.01):
         pytest.fail(f"seed {seed}: no leader converged after heal")
-    # drain replication to all final members
+    # drain replication to all final members: tick the leader until
+    # everyone applied the marker (condition-gated, not a fixed count —
+    # a loaded CI box drains slower, not differently)
     lead = cluster.nodes[cluster.leader]
-    for _ in range(200):
-        lead.tick()
-        if all(cluster.states[m] and cluster.states[m][-1] == marker
-               for m in cluster.members):
-            break
-        time.sleep(0.02)
+
+    def _drain_tick() -> None:
+        try:
+            lead.tick()
+        except RpcError:
+            pass
+
+    _poll(lambda: all(
+        cluster.states[m] and cluster.states[m][-1] == marker
+        for m in cluster.members
+    ), 30.0, 0.02, on_tick=_drain_tick)
 
     final = cluster.states[cluster.leader]
     try:
@@ -482,24 +513,27 @@ def _run_voted_schedule(tmp_path, seed: int) -> None:
 
     # convergence: elected leader commits a marker; all nodes apply it
     marker = {"v": seed, "marker": True}
-    deadline = time.time() + 25.0
-    while time.time() < deadline:
+    vmarked: list[bool] = []
+
+    def _try_vmarker() -> bool:
+        if vmarked:
+            return True
         lead = cluster.leader()
-        if lead is not None:
-            try:
-                lead.propose([marker])
-                break
-            except RpcError:
-                pass
-        time.sleep(0.05)
-    else:
+        if lead is None:
+            return False
+        try:
+            lead.propose([marker])
+            vmarked.append(True)
+            return True
+        except RpcError:
+            return False
+
+    if not _poll(_try_vmarker, 35.0, 0.05):
         cluster.close()
         pytest.fail(f"voted seed {seed}: no leader after heal")
-    deadline = time.time() + 15.0
-    while time.time() < deadline and not all(
+    _poll(lambda: all(
         s and s[-1] == marker for s in cluster.states.values()
-    ):
-        time.sleep(0.05)
+    ), 25.0, 0.05)
 
     final = max(cluster.states.values(), key=len)
     try:

@@ -29,6 +29,12 @@ def main(argv: list[str] | None = None) -> int:
         from vearch_tpu.tools.elastic_cli import main as elastic_main
 
         return elastic_main(argv)
+    if argv and argv[0] == "doctor":
+        # cluster doctor: fan out, collect evidence, check the standing
+        # runtime invariants, exit non-zero on any violation
+        from vearch_tpu.obs.doctor import main as doctor_main
+
+        return doctor_main(argv[1:])
 
     ap = argparse.ArgumentParser(prog="vearch_tpu")
     ap.add_argument("--role", default="standalone",

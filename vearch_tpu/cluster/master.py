@@ -258,6 +258,10 @@ class MasterServer:
         # (a quorum write per 2s heartbeat would be absurd); feeds the
         # cluster gauges below (reference: monitor_service.go:51-73)
         self._node_stats: dict[int, dict[str, dict]] = {}
+        # runtime-truth digest riding the same heartbeat: per-node
+        # {hbm_drift, drift_bytes, compiles_post_warmup} from the PS
+        # device sampler + compile flight recorder
+        self._node_obs: dict[int, dict] = {}
         self._register_cluster_gauges()
 
         if self.replicated:
@@ -657,6 +661,7 @@ class MasterServer:
                         # replica-fallback in the space gauges) would
                         # show stale numbers for the process lifetime
                         self._node_stats.pop(node_id, None)
+                        self._node_obs.pop(node_id, None)
                         self._failover_node(node_id)
             except Exception as e:
                 # store mutations propose through the meta log and can
@@ -1070,7 +1075,24 @@ class MasterServer:
                            "status": status, "partitions": parts})
             if rank[status] > rank[worst]:
                 worst = status
-        return {"status": worst if spaces else "green", "spaces": spaces,
+        status = worst if spaces else "green"
+        # runtime-truth degradation: a node whose measured HBM has
+        # drifted off the footprint model is still serving, but its
+        # capacity math (rebalance placement, admission) is built on a
+        # model that is now provably wrong — that is a yellow cluster
+        # even when every partition is fully replicated
+        drift_nodes = sorted(
+            nid for nid, obs in list(self._node_obs.items())
+            if obs.get("hbm_drift")
+        )
+        if drift_nodes and rank[status] < rank["yellow"]:
+            status = "yellow"
+        return {"status": status, "spaces": spaces,
+                "hbm_drift_nodes": drift_nodes,
+                "serving_compiles": sum(
+                    int(obs.get("compiles_post_warmup") or 0)
+                    for obs in list(self._node_obs.values())
+                ),
                 "builds_running": builds_running,
                 "builds_failed": builds_failed,
                 "splits_running": splits_running,
@@ -1276,6 +1298,8 @@ class MasterServer:
             self.store.delete(f"/fail_server/{node_id}")
         if "partitions" in body:
             self._node_stats[node_id] = body["partitions"] or {}
+        if "obs" in body:
+            self._node_obs[node_id] = body["obs"] or {}
         # field-index + schema expectations for the partitions this node
         # hosts: heals replicas that missed a /field_index or
         # /ps/schema/field fan-out (transient RPC failure, or a restart
@@ -1773,6 +1797,23 @@ class MasterServer:
                     if r == part.leader:
                         out = res
                 results.append(out)
+            # a restore rewrites partition data OUT OF BAND of the
+            # write path, so router merged-result entries validated by
+            # apply version can still look "current" while describing
+            # pre-restore data. Re-put the space key (every router's
+            # watch evicts through it) and synchronously evict entries
+            # touching the restored partitions on each live router —
+            # the next search recomputes against restored data.
+            self.store.put(f"{PREFIX_SPACE}{db}/{name}", space.to_dict())
+            pids = [p.id for p in space.partitions]
+            for rt in self.store.prefix("/router/").values():
+                try:
+                    rpc.call(rt["addr"], "POST", "/cache/invalidate",
+                             {"pids": pids}, timeout=5.0)
+                except RpcError:
+                    # unreachable router: its watch + entry TTL still
+                    # converge, just not synchronously
+                    continue
             return {"version": version, "partitions": results}
 
         raise RpcError(400, f"unknown backup command {command!r}")

@@ -35,6 +35,7 @@ from vearch_tpu.cluster.metrics import (
     register_tracer_metrics,
 )
 from vearch_tpu.cluster.raft import RaftNode
+from vearch_tpu.ops import perf_model
 from vearch_tpu.cluster.rpc import (
     ERR_REQUEST_KILLED,
     JsonRpcServer,
@@ -136,6 +137,8 @@ class PSServer:
         "slow_routed": "_stats_lock",
         "_search_ewma": "_stats_lock",
         "_op_counts": "_stats_lock",
+        "_op_inflight": "_stats_lock",
+        "_op_waiting": "_stats_lock",
         "_split_jobs": "_split_lock",
     }
 
@@ -156,6 +159,9 @@ class PSServer:
         labels: dict[str, str] | None = None,
         trace_collector: str | None = None,
         search_cache_entries: int = 256,
+        device_sample_interval: float = 5.0,
+        hbm_drift_tolerance: float = 0.5,
+        hbm_drift_slack_mb: int = 64,
     ):
         from vearch_tpu.utils import apply_jax_platform_env
 
@@ -226,6 +232,11 @@ class PSServer:
         # heartbeat — the master's rebalance planner scores hotness
         # from the deltas
         self._op_counts: dict[int, dict[str, int]] = {}
+        # admission observability for ROADMAP item 5: requests waiting
+        # on a gate vs executing, per op. Both render as gauges from
+        # the first scrape (fixed op label set) — cardinality-soak safe.
+        self._op_waiting: dict[str, int] = {"search": 0, "write": 0}
+        self._op_inflight: dict[str, int] = {"search": 0, "write": 0}
         self.slow_request_ms = 0
         self.killed_requests = 0
         # per-request deadline default (ms); a search may override via
@@ -281,6 +292,24 @@ class PSServer:
         # /ps/engine/config {"slow_log_ms": ...}
         self.slowlog = SlowLog()
 
+        # runtime truth layer (obs tentpole): compile-audit flight
+        # recorder (process-global, like the jit cache it watches),
+        # per-(partition, op) latency quantile sketches, and the
+        # device-runtime sampler measuring live HBM against the
+        # footprint model
+        from vearch_tpu.obs import flight_recorder as _flightrec
+        from vearch_tpu.obs.quantiles import QuantileRegistry, _qlabel
+        from vearch_tpu.obs.sampler import DeviceSampler
+
+        self.flight_recorder = _flightrec.install()
+        self.latency_quantiles = QuantileRegistry(name="ps.quantiles")
+        self.device_sampler = DeviceSampler(
+            self._model_device_bytes,
+            interval_s=device_sample_interval,
+            drift_tolerance=hbm_drift_tolerance,
+            drift_slack_bytes=int(hbm_drift_slack_mb) << 20,
+        )
+
         self.server = JsonRpcServer(host, port)
         self.server.tracer = self.tracer
         s = self.server
@@ -305,6 +334,9 @@ class PSServer:
         s.route("GET", "/ps/requests", self._h_requests)
         s.route("GET", "/ps/jobs", self._h_jobs)
         s.route("GET", "/debug/slowlog", self._h_slowlog)
+        # compile-audit flight recorder: post-warmup serving compiles
+        s.route("GET", "/debug/compiles", self._h_compiles)
+        s.route("POST", "/debug/compiles/reset", self._h_compiles_reset)
         # online partition split (elastic data plane): the master drives
         # start -> poll progress -> finish(commit|abort) on the parent's
         # leader; the double-write mirror lives here
@@ -574,6 +606,87 @@ class PSServer:
                            "scalar-filter bitmap cache events summed "
                            "across hosted engines",
                            ("event",), _filter_cache_events)
+
+        # runtime truth layer (obs tentpole). Device labels are bounded
+        # by the local device count, op/q labels by fixed tuples — all
+        # rendered from the first scrape, so the cardinality soak sees
+        # zero growth. The compile counter only mints a series when a
+        # post-warmup compile actually happens, which is precisely the
+        # regression it exists to expose.
+        def _device_bytes():
+            snap = self.device_sampler.snapshot()
+            return {(lbl,): float(b)
+                    for lbl, b in snap["devices"].items()}
+
+        m.callback_gauge("vearch_ps_device_hbm_live_bytes",
+                         "live device buffer bytes per local device, "
+                         "as sampled from the JAX runtime",
+                         ("device",), _device_bytes)
+        m.callback_counter("vearch_ps_h2d_bytes_total",
+                           "host->device transfer bytes accumulated by "
+                           "the absorb/upload paths (process-wide)",
+                           (),
+                           lambda: {(): float(perf_model.h2d_bytes_total())})
+        m.callback_gauge("vearch_ps_compiled_programs",
+                         "live jit-cache entries across registered "
+                         "serving programs",
+                         (),
+                         lambda: {(): float(
+                             perf_model.total_compiled_programs())})
+        m.callback_gauge("vearch_ps_hbm_model_drift_bytes",
+                         "measured live device bytes in excess of the "
+                         "footprint model + start baseline (worst "
+                         "device)",
+                         (),
+                         lambda: {(): float(
+                             self.device_sampler.snapshot()["drift_bytes"])})
+        m.callback_gauge("vearch_ps_hbm_model_drift",
+                         "1 when measured HBM exceeds the footprint "
+                         "model beyond tolerance (degrades "
+                         "/cluster/health)",
+                         (),
+                         lambda: {(): float(
+                             1.0 if self.device_sampler.snapshot()["drift"]
+                             else 0.0)})
+        m.callback_counter("vearch_serving_compiles_total",
+                           "post-warmup XLA compilations on serving "
+                           "paths, by registered program",
+                           ("path",),
+                           lambda: {(p,): float(n) for p, n in
+                                    self.flight_recorder.counts().items()})
+
+        def _latency_quantiles():
+            snap = self.latency_quantiles.snapshot()
+            out = {}
+            for op in ("search", "write"):
+                node_q = (snap.get(("_node", op)) or {}).get("q", {})
+                for q in self.latency_quantiles.quantiles:
+                    lbl = _qlabel(q)
+                    out[(op, lbl)] = float(node_q.get(lbl, 0.0))
+            return out
+
+        m.callback_gauge("vearch_ps_latency_quantile",
+                         "streaming latency quantiles (ms) per op, "
+                         "node-level P2 sketch",
+                         ("op", "q"), _latency_quantiles)
+
+        def _queue_depth():
+            with self._stats_lock:
+                return {(op,): float(n)
+                        for op, n in self._op_waiting.items()}
+
+        def _inflight_ops():
+            with self._stats_lock:
+                return {(op,): float(n)
+                        for op, n in self._op_inflight.items()}
+
+        m.callback_gauge("vearch_ps_queue_depth",
+                         "requests waiting on the admission gate, "
+                         "per op",
+                         ("op",), _queue_depth)
+        m.callback_gauge("vearch_ps_inflight",
+                         "requests currently executing, per op",
+                         ("op",), _inflight_ops)
         register_tracer_metrics(m, self.tracer)
 
     # -- lifecycle -----------------------------------------------------------
@@ -582,7 +695,11 @@ class PSServer:
         self.server.start()
         if self.master_addr:
             self._register()
-        self._recover_partitions()
+        # engine open/recovery compiles are expected — keep them out of
+        # the serving-compile audit
+        with self.flight_recorder.warmup():
+            self._recover_partitions()
+        self.device_sampler.start()
         if self.master_addr:
             threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="ps-heartbeat").start()
@@ -595,6 +712,7 @@ class PSServer:
 
     def stop(self, flush: bool = True) -> None:
         self._stop.set()
+        self.device_sampler.stop()
         for pid in list(self.raft_nodes):
             if flush:
                 try:
@@ -693,6 +811,15 @@ class PSServer:
                 continue
         return out
 
+    def _obs_summary(self) -> dict:
+        """Drift + compile digest riding the heartbeat."""
+        samp = self.device_sampler.snapshot()
+        return {
+            "hbm_drift": bool(samp.get("drift")),
+            "drift_bytes": int(samp.get("drift_bytes") or 0),
+            "compiles_post_warmup": self.flight_recorder.total(),
+        }
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             time.sleep(self.heartbeat_interval)
@@ -701,7 +828,10 @@ class PSServer:
                     self.master_addr, "POST", "/register",
                     {"rpc_addr": self.addr, "node_id": self.node_id,
                      "labels": self.labels,
-                     "partitions": self._partition_stats()},
+                     "partitions": self._partition_stats(),
+                     # runtime-truth digest: the master's health
+                     # rollup degrades on drift without polling us
+                     "obs": self._obs_summary()},
                     auth=self.master_auth,
                 )
             except RpcError:
@@ -1147,8 +1277,9 @@ class PSServer:
                 raise RpcError(409, f"partition {pid} already exists")
             schema = TableSchema.from_dict(body["schema"])
             pdir = os.path.join(self.data_dir, f"partition_{pid}")
-            eng = Engine(schema, data_dir=pdir)
-            eng.dump()  # schema on disk immediately: crash-openable
+            with self.flight_recorder.warmup():
+                eng = Engine(schema, data_dir=pdir)
+                eng.dump()  # schema on disk immediately: crash-openable
             eng.start_refresh_loop()
             self._wire_engine(pid, eng)
             self.engines[pid] = eng
@@ -1183,7 +1314,27 @@ class PSServer:
 
     # -- writes: every mutation is a log proposal ---------------------------
 
+    def _observed_write(self, body: dict, fn, parts) -> dict:
+        """Write-op observability shim: inflight gauge + latency
+        quantile sketch around the real handler (mirrors what the
+        search path does inline)."""
+        pid = int(body["partition_id"])
+        t0 = time.monotonic()
+        with self._stats_lock:
+            self._op_inflight["write"] += 1
+        try:
+            return fn(body, parts)
+        finally:
+            with self._stats_lock:
+                self._op_inflight["write"] -= 1
+            ms = (time.monotonic() - t0) * 1e3
+            self.latency_quantiles.observe((pid, "write"), ms)
+            self.latency_quantiles.observe(("_node", "write"), ms)
+
     def _h_upsert(self, body: dict, _parts) -> dict:
+        return self._observed_write(body, self._h_upsert_inner, _parts)
+
+    def _h_upsert_inner(self, body: dict, _parts) -> dict:
         import uuid
 
         from vearch_tpu.cluster.tracing import NULL_SPAN
@@ -1288,6 +1439,9 @@ class PSServer:
             span.set_tag(phase, ms)
 
     def _h_delete(self, body: dict, _parts) -> dict:
+        return self._observed_write(body, self._h_delete_inner, _parts)
+
+    def _h_delete_inner(self, body: dict, _parts) -> dict:
         from vearch_tpu.cluster.tracing import NULL_SPAN
 
         pid = int(body["partition_id"])
@@ -1457,12 +1611,21 @@ class PSServer:
             with self._stats_lock:
                 self.slow_routed += 1
         t_gate = time.monotonic()
-        if not gate.acquire(timeout=30.0):
+        with self._stats_lock:
+            self._op_waiting["search"] += 1
+        try:
+            acquired = gate.acquire(timeout=30.0)
+        finally:
+            with self._stats_lock:
+                self._op_waiting["search"] -= 1
+        if not acquired:
             raise RpcError(
                 429,
                 "partition server %s queue full"
                 % ("slow-search" if slow else "search"),
             )
+        with self._stats_lock:
+            self._op_inflight["search"] += 1
         gate_wait_ms = round((time.monotonic() - t_gate) * 1e3, 3)
         rid = str(body.get("request_id") or uuid.uuid4().hex)
         token = uuid.uuid4().hex  # unique even when clients reuse rids
@@ -1500,6 +1663,11 @@ class PSServer:
             {} if (want_trace or ctx.deadline is not None
                    or self.slowlog.threshold_ms > 0) else None
         )
+        # compile attribution: a serving-path compilation during this
+        # request's dispatches lands in /debug/compiles carrying this id
+        from vearch_tpu.obs import flight_recorder as _flightrec
+
+        _trace_token = _flightrec.set_active_trace(span.trace_id or rid)
         try:
             with span:
                 # apply version captured BEFORE the search runs: a
@@ -1574,10 +1742,15 @@ class PSServer:
             raise RpcError(ERR_REQUEST_KILLED,
                            f"request_killed: request {rid}: {e}") from e
         finally:
+            _flightrec.reset_active_trace(_trace_token)
             with self._inflight_lock:
                 self._inflight.pop(token, None)
             gate.release()
+            with self._stats_lock:
+                self._op_inflight["search"] -= 1
             ms = (time.monotonic() - t_start) * 1e3
+            self.latency_quantiles.observe((pid, "search"), ms)
+            self.latency_quantiles.observe(("_node", "search"), ms)
             # lock-fix note: the EWMA read-modify-write was documented
             # as benignly racy, but a torn read-modify-write pair can
             # resurrect a stale latency forever — _stats_lock is cheap
@@ -1616,8 +1789,11 @@ class PSServer:
             and body.get("cache", True) is not False
             and not body.get("raft_consistent")
             # trace:true promises a real phase/dispatch breakdown and
-            # a replayed span tree — a hit has neither to offer
+            # a replayed span tree — a hit has neither to offer;
+            # profile:true is a measurement of the engine path, so
+            # serving it a memoized envelope would be lying
             and not body.get("trace")
+            and not body.get("profile")
         )
         if not cacheable:
             if body.get("cache", True) is False:
@@ -1772,10 +1948,14 @@ class PSServer:
         job next to the searches it competed with."""
         job = None
         try:
-            if rebuild:
-                eng.rebuild_index()
-            else:
-                eng.build_index()
+            # index (re)builds legitimately compile: train/assign/
+            # publish kernels plus the post-publish warmup pass all
+            # specialize here, none of it is a serving-path regression
+            with self.flight_recorder.warmup():
+                if rebuild:
+                    eng.rebuild_index()
+                else:
+                    eng.build_index()
         finally:
             job = eng.build_job
             if job is not None:
@@ -1825,6 +2005,38 @@ class PSServer:
     def _h_slowlog(self, _body, _parts) -> dict:
         return {"threshold_ms": self.slowlog.threshold_ms,
                 "entries": self.slowlog.entries()}
+
+    def _h_compiles(self, _body, _parts) -> dict:
+        """GET /debug/compiles — the compile-audit flight recorder's
+        view: every post-warmup serving-path compilation with its shape
+        signature, wall time, and originating trace id."""
+        rec = self.flight_recorder
+        return {
+            "total": rec.total(),
+            "counts": rec.counts(),
+            "warmup_compiles": rec.warmup_compiles,
+            "events": rec.events(),
+        }
+
+    def _h_compiles_reset(self, _body, _parts) -> dict:
+        """POST /debug/compiles/reset — operator marks 'warmed now':
+        after deliberate warmup traffic, zero the recorder so the
+        doctor's post-warmup invariant measures only what follows."""
+        before = self.flight_recorder.total()
+        self.flight_recorder.reset()
+        return {"reset": True, "dropped_events": before}
+
+    def _model_device_bytes(self) -> int:
+        """Footprint-model side of the drift gauge: modeled per-device
+        resident bytes summed over hosted engines' indexes."""
+        total = 0
+        for eng in list(self.engines.values()):
+            for idx in list(getattr(eng, "indexes", {}).values()):
+                try:
+                    total += int(idx.device_footprint_per_device_bytes())
+                except Exception:
+                    continue
+        return total
 
     # -- online partition split (elastic data plane) -------------------------
     #
@@ -2409,6 +2621,7 @@ class PSServer:
                 n = store.get_tree(body["key_prefix"], stage)
             with self._flush_lock(pid), \
                     node._apply_lock:
+                old_version = int(eng.data_version)
                 eng.close()
                 for name in list(os.listdir(data_dir)):
                     if name in ("raft", "partition.json"):
@@ -2418,7 +2631,18 @@ class PSServer:
                 for name in os.listdir(stage):
                     os.replace(os.path.join(stage, name),
                                os.path.join(data_dir, name))
-                restored = Engine.open(data_dir)
+                with self.flight_recorder.warmup():
+                    restored = Engine.open(data_dir)
+                # restore is a data rewrite the version counters must
+                # not hide: a fresh Engine.open restarts data_version
+                # at/below the pre-restore value, which would leave
+                # version-exact cache keys (PS search cache) and the
+                # router's apply-version validity maps believing their
+                # pre-restore entries still describe this partition.
+                # Force it strictly past everything ever served.
+                restored.data_version = (
+                    max(int(restored.data_version), old_version) + 1
+                )
                 restored.start_refresh_loop()
                 self._wire_engine(pid, restored)
                 with self._lock:
@@ -2444,6 +2668,11 @@ class PSServer:
                 "doc_count": restored.doc_count}
 
     def _h_stats(self, _body, _parts) -> dict:
+        with self._stats_lock:
+            op_load = {
+                "queue_depth": dict(self._op_waiting),
+                "inflight": dict(self._op_inflight),
+            }
         return {
             "node_id": self.node_id,
             "replication_errors": self.replication_errors,
@@ -2453,6 +2682,16 @@ class PSServer:
                 "entries": len(self.search_cache),
                 **self.search_cache.stats,
             },
+            # runtime truth: last device sample (live HBM, h2d bytes,
+            # compiled-program count, footprint-model drift verdict)
+            "device_sampler": self.device_sampler.snapshot(),
+            # per-(partition, op) streaming tail quantiles; "_node" is
+            # the node-level sketch the Prometheus gauge renders
+            "latency_quantiles": {
+                f"{key[0]}/{key[1]}": rec
+                for key, rec in self.latency_quantiles.snapshot().items()
+            },
+            "op_load": op_load,
             # snapshot under no lock: stale reads are fine for stats
             "search_ewma_ms": {
                 str(pid): round(ms, 2)

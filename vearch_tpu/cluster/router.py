@@ -84,6 +84,14 @@ class RouterServer:
         self.result_cache = VersionedLRUCache(
             max_entries=cache_entries, ttl_s=cache_ttl_s)
         self._search_flight = SingleFlight()
+        # streaming tail quantiles over per-partition scatter RTTs
+        # (P^2 sketches, fixed memory): the router-side half of the
+        # latency story — PS quantiles say how long the engine took,
+        # these say what the fan-out actually cost this router
+        from vearch_tpu.obs.quantiles import QuantileRegistry
+
+        self.latency_quantiles = QuantileRegistry(
+            name="router.quantiles")
         self._part_versions: dict[int, int] = {}
         self._part_versions_lock = threading.Lock()
         # partition-map hot reload (elasticity): newest map version
@@ -113,7 +121,11 @@ class RouterServer:
         self.server = JsonRpcServer(
             host, port,
             authenticator=self._authenticate if auth else None,
-            auth_exempt=("/cluster/health",),
+            # /cache/invalidate is exempt like the health probe: it
+            # carries no data in either direction and evicting cache
+            # entries is always safe — the master's restore fanout must
+            # work without holding router credentials
+            auth_exempt=("/cluster/health", "/cache/invalidate"),
         )
         s = self.server
         s.route("POST", "/document/upsert", self._h_upsert)
@@ -134,6 +146,7 @@ class RouterServer:
         s.route("GET", "/cache/dbs", self._h_cache_space)
         s.route("GET", "/cluster/health", self._h_health)
         s.route("GET", "/router/stats", self._h_router_stats)
+        s.route("POST", "/cache/invalidate", self._h_cache_invalidate)
         s.route("GET", "/debug/slowlog", self._h_slowlog)
         s.tracer = self.tracer  # serves GET /debug/traces
         from vearch_tpu.cluster.metrics import register_tracer_metrics
@@ -179,6 +192,26 @@ class RouterServer:
             "= a partition RPC 404ed after a remap)", ("trigger",))
         for t in ("version", "moved"):
             self._m_map_reloads.inc(t, by=0.0)
+
+        def _router_quantiles():
+            from vearch_tpu.obs.quantiles import (
+                TRACKED_QUANTILES, _qlabel,
+            )
+
+            snap = self.latency_quantiles.snapshot()
+            out = {}
+            for op in ("scatter",):
+                rec = snap.get(("_node", op)) or {"q": {}}
+                for q in TRACKED_QUANTILES:
+                    out[(op, _qlabel(q))] = float(
+                        rec["q"].get(_qlabel(q), 0.0))
+            return out
+
+        m.callback_gauge(
+            "vearch_router_latency_quantile",
+            "streaming tail-latency quantiles of per-partition scatter "
+            "RPCs as this router sees them (P^2 sketch, ms)",
+            ("op", "q"), _router_quantiles)
 
     def start(self) -> None:
         self.server.start()
@@ -261,11 +294,11 @@ class RouterServer:
                 # db drop / alias retarget change space resolution in
                 # ways a space-key diff does not capture
                 everything = True
+        doomed_pids: set[int] = set()
         with self._cache_lock:
             if everything:
                 self._space_cache.clear()
                 self._server_cache = (0.0, {})
-                return
             for sk in spaces:
                 self._space_cache.pop(sk, None)
                 # alias-resolved entries cache under the ALIAS key but
@@ -273,11 +306,25 @@ class RouterServer:
                 # the back-map or alias users would stay stale
                 for alias_key in self._alias_backmap.pop(sk, ()):
                     self._space_cache.pop(alias_key, None)
+                # a space-key change can mean an out-of-band data
+                # rewrite (restore re-puts the key): merged results
+                # computed over its partitions are no longer evidence
+                doomed_pids |= self._space_pids.get(sk, set())
             if servers:
                 self._server_cache = (0.0, {})
+        if everything:
+            self.result_cache.clear()
+        elif doomed_pids:
+            self.result_cache.evict_pids(doomed_pids)
 
     def _h_router_stats(self, _body, _parts) -> dict:
         now = time.monotonic()
+        # merged latency view: the node-level scatter sketch plus the
+        # per-partition breakdown, keyed "pid/op" for wire transport
+        quant = {
+            f"{key[0]}/{key[1]}": rec
+            for key, rec in self.latency_quantiles.snapshot().items()
+        }
         with self._cache_lock:
             return {
                 "watch_rev": self._watch_rev,
@@ -294,7 +341,22 @@ class RouterServer:
                     "entries": len(self.result_cache),
                     **self.result_cache.stats,
                 },
+                "latency_quantiles": quant,
             }
+
+    def _h_cache_invalidate(self, body, _parts) -> dict:
+        """Targeted merged-result eviction, called by the master after
+        an out-of-band data rewrite (restore) so stale entries die NOW
+        instead of at TTL/next-version check. ``pids`` evicts entries
+        touching those partitions; no pids clears everything."""
+        body = body or {}
+        pids = body.get("pids")
+        if pids:
+            dropped = self.result_cache.evict_pids(
+                {int(p) for p in pids})
+        else:
+            dropped = self.result_cache.clear()
+        return {"evicted": dropped}
 
     def _ensure_pool_capacity(self, n_partitions: int) -> None:
         """Auto-size the fan-out pool to the widest space served so
@@ -351,6 +413,8 @@ class RouterServer:
             with self._part_versions_lock:
                 for pid in removed:
                     self._part_versions.pop(pid, None)
+            for pid in removed:  # retire their latency sketches too
+                self.latency_quantiles.drop((pid, "scatter"))
 
     def _observe_map_version(self, skey: tuple[str, str],
                              version) -> None:
@@ -1040,15 +1104,19 @@ class RouterServer:
                 sub["trace"] = True  # sampled spans imply phase timings
 
             # merged-result cache: consistent reads must see the log
-            # (raft_consistent), and trace:true promises per-partition
-            # timing that a hit cannot produce — both fall through to
-            # the scatter path. The entry validates against the per-
-            # partition apply versions recorded when it was computed.
+            # (raft_consistent), trace:true promises per-partition
+            # timing that a hit cannot produce, and profile:true is a
+            # measurement of the live fan-out path — serving any of
+            # them a memoized envelope would be lying. All three fall
+            # through to the scatter path. The entry validates against
+            # the per-partition apply versions recorded when it was
+            # computed.
             cacheable = (
                 self.result_cache.max_entries > 0
                 and sub["cache"]
                 and not sub["raft_consistent"]
                 and not explicit_trace
+                and not want_profile
             )
             pids = [p.id for p in space.partitions]
             ckey = None
@@ -1201,6 +1269,12 @@ class RouterServer:
             self._note_apply_version(pid, r.get("apply_version"))
             self._observe_map_version(skey, r.get("map_version"))
             r["_rpc_ms"] = round((_time.monotonic() - t0) * 1e3, 3)
+            # tail-quantile sketches: per-partition for /router/stats,
+            # node-level for the vearch_router_latency_quantile gauge
+            self.latency_quantiles.observe((pid, "scatter"),
+                                           r["_rpc_ms"])
+            self.latency_quantiles.observe(("_node", "scatter"),
+                                           r["_rpc_ms"])
             return pid, r
 
         futures = [

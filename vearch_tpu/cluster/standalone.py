@@ -16,12 +16,21 @@ from vearch_tpu.cluster.router import RouterServer
 
 
 class StandaloneCluster:
-    def __init__(self, data_dir: str | None = None, n_ps: int = 1):
+    def __init__(
+        self,
+        data_dir: str | None = None,
+        n_ps: int = 1,
+        ps_kwargs: dict | None = None,
+    ):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="vearch_tpu_")
         self.master = MasterServer()
         self.ps_nodes: list[PSServer] = []
         self.router: RouterServer | None = None
         self.n_ps = n_ps
+        # extra PSServer ctor args, applied to every node — lets tests
+        # tighten observability knobs (drift slack, sample interval)
+        # without reaching into started servers
+        self.ps_kwargs = dict(ps_kwargs or {})
 
     def start(self) -> "StandaloneCluster":
         self.master.start()
@@ -29,6 +38,7 @@ class StandaloneCluster:
             ps = PSServer(
                 data_dir=f"{self.data_dir}/ps{i}",
                 master_addr=self.master.addr,
+                **self.ps_kwargs,
             )
             ps.start()
             self.ps_nodes.append(ps)
@@ -43,6 +53,7 @@ class StandaloneCluster:
         ps = PSServer(
             data_dir=f"{self.data_dir}/ps{len(self.ps_nodes)}",
             master_addr=self.master.addr,
+            **self.ps_kwargs,
         )
         ps.start()
         self.ps_nodes.append(ps)

@@ -807,18 +807,23 @@ class Engine:
             job["phase"] = phase
             job["updated"] = wall0 + t1
 
+        # train/assign specialise kernels by design — expected compiles,
+        # not serving-path regressions the flight recorder should ring
+        from vearch_tpu.obs.flight_recorder import RECORDER
+
         self.status = IndexStatus.TRAINING
         try:
-            for name, index in targets:
-                store = self.vector_stores[name]
-                if index.needs_training and not index.trained:
+            with RECORDER.warmup():
+                for name, index in targets:
+                    store = self.vector_stores[name]
+                    if index.needs_training and not index.trained:
+                        t0 = time.monotonic()
+                        index.train(store.host_view())
+                        mark("train", t0, time.monotonic())
                     t0 = time.monotonic()
-                    index.train(store.host_view())
-                    mark("train", t0, time.monotonic())
-                t0 = time.monotonic()
-                index.absorb(store.count)
-                mark("assign", t0, time.monotonic())
-                job["docs_done"] += store.count
+                    index.absorb(store.count)
+                    mark("assign", t0, time.monotonic())
+                    job["docs_done"] += store.count
         except Exception as e:
             # a failed (possibly background) build must not wedge the
             # engine in TRAINING: record, reset, keep serving brute-force
@@ -871,7 +876,15 @@ class Engine:
         searches add ZERO new compiled programs. Returns the batch sizes
         traced per field.
         """
+        # warmup compiles are the point, not a serving regression: keep
+        # them out of the compile-audit flight recorder's ring
+        from vearch_tpu.obs.flight_recorder import RECORDER
+
         done: dict[str, list[int]] = {}
+        with RECORDER.warmup():
+            return self._warmup_inner(done, batches, k, field_name)
+
+    def _warmup_inner(self, done, batches, k, field_name):
         for name, index in self.indexes.items():
             if field_name is not None and name != field_name:
                 continue

@@ -35,12 +35,15 @@ from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
+from vearch_tpu.obs import flight_recorder as _flightrec
+
 if TYPE_CHECKING:  # pragma: no cover
     from vearch_tpu.engine.engine import Engine, SearchRequest, SearchResult
 
 
 class _Pending:
-    __slots__ = ("req", "rows", "done", "results", "error", "t_enqueue")
+    __slots__ = ("req", "rows", "done", "results", "error", "t_enqueue",
+                 "trace_id")
 
     def __init__(self, req: "SearchRequest", rows: int):
         self.req = req
@@ -53,6 +56,11 @@ class _Pending:
         # in-flight device dispatch (trace key queue_ms + a
         # microbatch.queue phase span)
         self.t_enqueue = time.monotonic()
+        # compile attribution crosses the thread hop with the request:
+        # the dispatcher thread re-binds this around the device call so
+        # a serving-path compile lands in /debug/compiles carrying the
+        # trace of the request that forced it
+        self.trace_id = _flightrec.current_trace()
 
 
 def _note_queue_wait(p: "_Pending", t_dequeue: float) -> None:
@@ -185,12 +193,14 @@ class MicroBatcher:
         t_dequeue = time.monotonic()
         if len(group) == 1:
             p = group[0]
+            tok = _flightrec.set_active_trace(p.trace_id)
             try:
                 _note_queue_wait(p, t_dequeue)
                 p.results = self.engine._search_direct(p.req)
             except Exception as e:
                 p.error = e
             finally:
+                _flightrec.reset_active_trace(tok)
                 p.done.set()
             return
 
@@ -220,13 +230,20 @@ class MicroBatcher:
                 score_bounds=head.score_bounds,
                 trace=trace,
             )
-            results = self.engine._search_direct(big)
+            # a combined dispatch has many originators; attribute any
+            # compile to the head — one real trace beats none
+            tok = _flightrec.set_active_trace(group[0].trace_id)
+            try:
+                results = self.engine._search_direct(big)
+            finally:
+                _flightrec.reset_active_trace(tok)
         except Exception:
             # One bad co-batched request (wrong dim, NaNs, ...) must not
             # fail its companymates: retry each pending alone so only the
             # genuinely bad ones error. Killed requests get their abort
             # instead of a full-cost re-run (same as the success path).
             for p in group:
+                tok = _flightrec.set_active_trace(p.trace_id)
                 try:
                     if p.req.ctx is not None and p.req.ctx.killed:
                         p.error = RequestKilled(
@@ -236,6 +253,7 @@ class MicroBatcher:
                 except Exception as e:
                     p.error = e
                 finally:
+                    _flightrec.reset_active_trace(tok)
                     p.done.set()
             return
         off = 0

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vearch_tpu.ops import perf_model
 from vearch_tpu.ops.distance import host_sqnorms
 
 
@@ -95,11 +96,16 @@ class RawVectorStore:
             self._device_sqnorm = jnp.asarray(
                 host_sqnorms(np.asarray(self._device))
             )
+            # .nbytes is metadata — no host sync
+            perf_model.note_h2d_bytes(
+                int(self._device.nbytes) + int(self._device_sqnorm.nbytes)
+            )
             self._device_rows = n
         elif self._device_rows < n:
             tail = jnp.asarray(
                 self._host[self._device_rows : n], dtype=self.store_dtype
             )
+            perf_model.note_h2d_bytes(int(tail.nbytes))
             self._device = jax.lax.dynamic_update_slice(
                 self._device, tail, (self._device_rows, 0)
             )

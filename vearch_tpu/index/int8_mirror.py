@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vearch_tpu.ops import perf_model
+
 
 def quantize_rows(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Per-row symmetric int8 quantization; returns (q8, scale, vsq)."""
@@ -168,9 +170,18 @@ class Int8Mirror:
             self._d8 = jnp.asarray(self._h8)
             self._d_scale = jnp.asarray(self._h_scale)
             self._d_vsq = jnp.asarray(self._h_vsq)
+            # .nbytes is metadata — no host sync
+            perf_model.note_h2d_bytes(
+                int(self._d8.nbytes) + int(self._d_scale.nbytes)
+                + int(self._d_vsq.nbytes)
+            )
             self._d_rows = n
         elif self._d_rows < n:
             sl = slice(self._d_rows, n)
+            perf_model.note_h2d_bytes(
+                int(self._h8[sl].nbytes) + int(self._h_scale[sl].nbytes)
+                + int(self._h_vsq[sl].nbytes)
+            )
             self._d8 = jax.lax.dynamic_update_slice(
                 self._d8, jnp.asarray(self._h8[sl]), (self._d_rows, 0)
             )

@@ -1,0 +1,18 @@
+"""Runtime truth layer: live telemetry for the perf-model invariants.
+
+CI asserts the footprint model, the zero-retrace gate, and the dispatch
+ledgers (`ops/perf_model.py`, `tests/test_perf_gates.py`); nothing in a
+*running* cluster used to measure whether device reality still matched.
+This package closes that gap:
+
+- ``quantiles``        fixed-memory P^2 latency sketches (tail gauges)
+- ``flight_recorder``  ring buffer of post-warmup serving compiles
+- ``sampler``          per-PS daemon reading the JAX runtime (live
+                       HBM bytes, H2D counters, compiled programs,
+                       footprint-model drift)
+- ``doctor``           cluster-wide collector + invariant checks
+
+Nothing here dispatches device programs (lint VL101: obs/ is not a
+dispatch package); the sampler only *reads* runtime introspection
+surfaces.
+"""

@@ -1,0 +1,149 @@
+"""Compile-audit flight recorder: post-warmup serving compiles, live.
+
+`tests/test_perf_gates.py` asserts zero retrace after warmup — in CI.
+In a running cluster a shape-churned request that forces XLA to compile
+on the serving path stalls that request for orders of magnitude longer
+than a dispatch, and nothing recorded it. The `register_jit` layer now
+detects jit-cache growth around every call of a registered program
+(`ops/perf_model.py`) and notifies this module's process-global
+recorder, which:
+
+- keeps a bounded ring of post-warmup compile events (program, shape
+  signature, wall time of the triggering call, active trace id) served
+  at ``GET /debug/compiles``;
+- feeds the ``vearch_serving_compiles_total`` counter (label ``path`` =
+  registered program name);
+- suppresses expected compiles: anything under a ``warmup()`` scope
+  (engine open/build/publish/restore, explicit warmup passes) is
+  counted separately and kept out of the ring.
+
+The recorder is process-global on purpose: the jit cache it audits is
+process-global too, so per-PS recorders in one process would disagree
+about which call compiled. Trace attribution stays per-request via a
+contextvar the PS sets around engine calls.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from collections import deque
+from typing import Any, Iterator
+
+from vearch_tpu.ops import perf_model
+from vearch_tpu.tools import lockcheck
+
+_active_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "vearch_obs_active_trace", default=None
+)
+
+
+def set_active_trace(trace_id: str | None) -> contextvars.Token:
+    """Bind the request's trace id for compile attribution; returns a
+    token for :func:`reset_active_trace`."""
+    return _active_trace.set(trace_id)
+
+
+def reset_active_trace(token: contextvars.Token) -> None:
+    _active_trace.reset(token)
+
+
+def current_trace() -> str | None:
+    """The calling context's bound trace id, if any — used to carry
+    attribution across thread hops (the microbatch dispatcher runs the
+    device call on its own thread, where the contextvar is unset)."""
+    return _active_trace.get()
+
+
+@lockcheck.guarded
+class CompileFlightRecorder:
+    """Ring buffer + counters for serving-path compilations."""
+
+    _guarded_by = {
+        "_events": "_lock",
+        "_counts": "_lock",
+        "_seen": "_lock",
+    }
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._lock = lockcheck.make_lock("obs.flight_recorder")
+        self._events: deque[dict] = deque(maxlen=self.capacity)
+        self._counts: dict[str, int] = {}  # program -> post-warmup n
+        # (program, shape_sig) pairs already recorded: one compile per
+        # specialisation, and a shield against the benign race where
+        # two threads watch the same cache-size step
+        self._seen: set[tuple[str, str]] = set()
+        self._warmup_depth = 0  # int; reads/writes under _lock
+        self.warmup_compiles = 0
+
+    @contextlib.contextmanager
+    def warmup(self) -> Iterator[None]:
+        """Scope for *expected* compilation: engine open/build/publish/
+        restore and explicit warmup passes. Re-entrant (refcounted)."""
+        with self._lock:
+            self._warmup_depth += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._warmup_depth -= 1
+
+    def in_warmup(self) -> bool:
+        with self._lock:
+            return self._warmup_depth > 0
+
+    def on_compile(
+        self, program: str, shape_sig: str, elapsed_ms: float
+    ) -> None:
+        """Observer callback installed into ``ops.perf_model``."""
+        trace_id = _active_trace.get()
+        with self._lock:
+            if self._warmup_depth > 0:
+                self.warmup_compiles += 1
+                return
+            key = (program, shape_sig)
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self._counts[program] = self._counts.get(program, 0) + 1
+            self._events.append({
+                # operator-facing stamp for log correlation, not math
+                "ts": time.time(),  # lint: allow[wall-clock] event stamp for operator correlation
+                "path": program,
+                "shapes": shape_sig,
+                "elapsed_ms": round(float(elapsed_ms), 3),
+                "trace_id": trace_id,
+            })
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def reset(self) -> None:
+        """Test hook: drop recorded state (the jit caches themselves
+        are untouched)."""
+        with self._lock:
+            self._events.clear()
+            self._counts.clear()
+            self._seen.clear()
+            self.warmup_compiles = 0
+
+
+#: process-global recorder — one per process, like the jit cache.
+RECORDER = CompileFlightRecorder()
+
+
+def install() -> CompileFlightRecorder:
+    """Hook the recorder into the register_jit layer (idempotent)."""
+    perf_model.set_compile_observer(RECORDER.on_compile)
+    return RECORDER

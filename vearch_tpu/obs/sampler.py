@@ -1,0 +1,196 @@
+"""Device-runtime sampler: measure what the perf model only predicts.
+
+A named daemon per PS periodically reads the JAX runtime:
+
+- **live HBM bytes per device** — ``device.memory_stats()`` where the
+  backend implements it (TPU/GPU), else summing the addressable shards
+  of ``jax.live_arrays()`` per device (the CPU backend's only truthful
+  accounting);
+- **host->device transfer bytes** — the process-wide accumulator the
+  mesh row caches and engine upload sites feed
+  (``ops.perf_model.note_h2d_bytes``);
+- **compiled program count** — ``perf_model.total_compiled_programs()``;
+- **footprint-model drift** — measured live bytes vs the sum of the
+  node's engines' ``VectorIndex.device_footprint_per_device_bytes()``.
+
+Drift is one-sided: untracked allocations only push *measured* above
+*model*, so the signal is ``max(0, measured - model - baseline)`` per
+device, flagged when it exceeds ``slack + tolerance * model``. The
+baseline is captured at sampler start so runtime-constant overheads
+(weights of warmed programs, other tenants in shared test processes)
+do not read as drift; what flips the flag is growth the model does not
+know about. The flag rides the PS heartbeat into the master, which
+degrades the ``/cluster/health`` rollup.
+
+Everything here *reads* introspection surfaces — no dispatches, no
+blocking on device work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from vearch_tpu.ops import perf_model
+from vearch_tpu.tools import lockcheck
+
+
+def device_label(dev: Any) -> str:
+    """Stable bounded label for a local device, e.g. ``cpu:0``."""
+    return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+
+
+def measure_live_bytes() -> dict[str, int]:
+    """Per-device live buffer bytes from the runtime.
+
+    Prefers ``device.memory_stats()['bytes_in_use']`` (TPU/GPU); falls
+    back to walking ``jax.live_arrays()`` and attributing each
+    addressable shard to its device (CPU backend).
+    """
+    import jax
+
+    out: dict[str, int] = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            out[device_label(d)] = int(stats["bytes_in_use"])
+    if out:
+        return out
+    acc: dict[str, int] = {
+        device_label(d): 0 for d in jax.local_devices()
+    }
+    for arr in jax.live_arrays():
+        try:
+            for sh in arr.addressable_shards:
+                lbl = device_label(sh.device)
+                acc[lbl] = acc.get(lbl, 0) + int(sh.data.nbytes)
+        except Exception:
+            # deleted/donated buffers race the walk; skip, next sample
+            # sees a consistent view
+            continue
+    return acc
+
+
+@lockcheck.guarded
+class DeviceSampler:
+    """Named daemon sampling the JAX runtime on a fixed interval.
+
+    ``model_bytes_fn`` returns the node's modeled per-device resident
+    bytes (sum of engine index footprints). ``snapshot()`` hands the
+    last sample to metric callbacks and ``/ps/stats``; ``sample_now()``
+    forces a synchronous sample (tests, doctor probes).
+    """
+
+    _guarded_by = {"_state": "_lock"}
+
+    def __init__(
+        self,
+        model_bytes_fn: Callable[[], int],
+        interval_s: float = 5.0,
+        drift_tolerance: float = 0.5,
+        drift_slack_bytes: int = 64 << 20,
+        name: str = "ps-device-sampler",
+    ):
+        self.model_bytes_fn = model_bytes_fn
+        self.interval_s = float(interval_s)
+        self.drift_tolerance = float(drift_tolerance)
+        self.drift_slack_bytes = int(drift_slack_bytes)
+        self._name = name
+        self._lock = lockcheck.make_lock("obs.sampler")
+        self._state: dict[str, Any] = {
+            "samples": 0,
+            "devices": {},
+            "h2d_bytes_total": 0,
+            "compiled_programs": 0,
+            "model_per_device_bytes": 0,
+            "baseline_per_device_bytes": {},
+            "drift_bytes": 0,
+            "drift": False,
+        }
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        # first sample is synchronous so metric callbacks render real
+        # values (and the full label set) from the very first scrape —
+        # the cardinality soak baselines at that point
+        self.sample_now()
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_now()
+            except Exception:
+                # sampling must never take the PS down; the stale
+                # `samples` count makes a wedged sampler visible
+                continue
+
+    def sample_now(self) -> dict[str, Any]:
+        devices = measure_live_bytes()
+        model = int(self.model_bytes_fn() or 0)
+        h2d = perf_model.h2d_bytes_total()
+        compiled = perf_model.total_compiled_programs()
+        with self._lock:
+            first = self._state["samples"] == 0
+            if first:
+                # runtime-constant overhead the footprint model never
+                # claimed to cover (compiled constants, co-tenants):
+                # everything resident before serving starts
+                self._state["baseline_per_device_bytes"] = {
+                    lbl: max(0, b - model)
+                    for lbl, b in devices.items()
+                }
+            base = self._state["baseline_per_device_bytes"]
+            drift_bytes = 0
+            for lbl, measured in devices.items():
+                excess = measured - model - base.get(lbl, 0)
+                drift_bytes = max(drift_bytes, excess)
+            drift_bytes = max(0, drift_bytes)
+            drift = drift_bytes > (
+                self.drift_slack_bytes + self.drift_tolerance * model
+            )
+            self._state.update({
+                "samples": self._state["samples"] + 1,
+                "devices": devices,
+                "h2d_bytes_total": h2d,
+                "compiled_programs": compiled,
+                "model_per_device_bytes": model,
+                "drift_bytes": int(drift_bytes),
+                "drift": bool(drift),
+            })
+            return dict(self._state)
+
+    def rebaseline(self) -> None:
+        """Re-capture the baseline (tests; operator after planned
+        topology changes)."""
+        with self._lock:
+            self._state["samples"] = 0
+        self.sample_now()
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            out = dict(self._state)
+            out["devices"] = dict(out["devices"])
+            return out
+
+
+def monotonic_ms() -> float:
+    """Shared latency clock for quantile observation sites."""
+    return time.monotonic() * 1000.0

@@ -604,7 +604,10 @@ def int8_scan_rerank(
 # compiled-program tracking (ops/perf_model.py): every jitted search
 # entry point registers here so tests can assert that repeated
 # same-shape searches add ZERO new compiled programs — the retrace /
-# compile-stall regression gate
+# compile-stall regression gate. The module global is rebound to the
+# returned observing proxy so the compile-audit flight recorder sees
+# cache growth on live calls (importers bind the proxy too: this runs
+# before any `from ... import` of these names executes).
 for _name, _fn in (
     ("ivf.ivfflat_candidates", ivfflat_candidates),
     ("ivf.ivfpq_candidates", ivfpq_candidates),
@@ -615,4 +618,4 @@ for _name, _fn in (
     ("ivf.exact_rerank_gathered", exact_rerank_gathered),
     ("ivf.int8_scan_rerank", int8_scan_rerank),
 ):
-    register_jit(_name, _fn)
+    globals()[_name.split(".", 1)[1]] = register_jit(_name, _fn)

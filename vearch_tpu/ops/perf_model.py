@@ -33,6 +33,9 @@ gates run identically with and without a TPU.
 
 from __future__ import annotations
 
+import enum
+import threading
+import time
 from typing import Any, Callable
 
 # must match ops/ivf.py BLOCK and pallas_kernels._SCAN_BLOCK
@@ -144,15 +147,101 @@ def path_for_dispatches(tags: list[str]) -> str | None:
 
 _JIT_REGISTRY: dict[str, Any] = {}
 
+# Optional compile observer (the obs/ flight recorder installs one):
+# called as observer(program_name, shape_signature, elapsed_ms) whenever
+# a *call* of a registered program grew its jit cache — i.e. XLA
+# compiled a new specialisation on what should be a warmed path.
+_compile_observer: Any = None
+
+
+def set_compile_observer(fn: Any) -> None:
+    """Install (or clear, with None) the process-wide compile observer."""
+    global _compile_observer
+    _compile_observer = fn
+
+
+def _sig_of(v: Any) -> str:
+    """One arg's contribution to a call signature: dtype+shape for
+    array-likes, the VALUE for plain scalars (static args specialise on
+    value — two calls differing only in a static ``k`` are different
+    programs and must not collapse to the same signature), type name
+    for everything else."""
+    shp = getattr(v, "shape", None)
+    if shp is not None:
+        dt = getattr(v, "dtype", None)
+        return f"{getattr(dt, 'name', dt)}{tuple(shp)}"
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return repr(v)
+    if isinstance(v, enum.Enum):
+        return str(v)
+    return type(v).__name__
+
+
+def _shape_signature(args: tuple, kwargs: dict) -> str:
+    """Compact abstract signature of a call: per-arg dtype+shape for
+    array-likes, value for static-able scalars. This is what XLA
+    specialises on, so it names the compile cause in flight-recorder
+    events."""
+    parts = [_sig_of(a) for a in args]
+    parts += [f"{k}={_sig_of(kwargs[k])}" for k in sorted(kwargs)]
+    return "|".join(parts)
+
+
+class _ObservedJit:
+    """Callable proxy over a registered jit entry point.
+
+    Detects jit-cache growth around each call — the only reliable
+    compile signal the public JAX API exposes — and notifies the
+    installed observer with the call's shape signature and wall time.
+    With no observer installed the call passes straight through; every
+    attribute access (``_cache_size``, ``lower``, ...) delegates to the
+    wrapped function, so the proxy is drop-in for existing callers.
+    """
+
+    __slots__ = ("_vearch_name", "_vearch_fn")
+
+    def __init__(self, name: str, fn: Any):
+        self._vearch_name = name
+        self._vearch_fn = fn
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        obs = _compile_observer
+        fn = self._vearch_fn
+        if obs is None:
+            return fn(*args, **kwargs)
+        try:
+            before = int(fn._cache_size())
+        except Exception:
+            before = -1
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        if before >= 0:
+            try:
+                grew = int(fn._cache_size()) > before
+            except Exception:
+                grew = False
+            if grew:
+                obs(
+                    self._vearch_name,
+                    _shape_signature(args, kwargs),
+                    (time.perf_counter() - t0) * 1000.0,
+                )
+        return out
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._vearch_fn, item)
+
 
 def register_jit(name: str, fn: Any) -> Any:
     """Register a jitted search entry point for compile tracking.
 
-    Returns `fn` so modules can write
-    ``fn = register_jit("name", jax.jit(...))``.
+    Returns an observing proxy of `fn` so modules can write
+    ``fn = register_jit("name", jax.jit(...))``; the raw function stays
+    in the registry so :func:`compiled_program_counts` reads the jit
+    cache directly.
     """
     _JIT_REGISTRY[name] = fn
-    return fn
+    return _ObservedJit(name, fn)
 
 
 def compiled_program_counts() -> dict[str, int]:
@@ -174,6 +263,27 @@ def compiled_program_counts() -> dict[str, int]:
 
 def total_compiled_programs() -> int:
     return sum(max(v, 0) for v in compiled_program_counts().values())
+
+
+# Process-wide host->device transfer accounting. The mesh row caches
+# and the engine device_put sites already count their own H2D bytes
+# per instance; this accumulator is the cross-instance total the
+# device-runtime sampler exports as vearch_ps_h2d_bytes_total. A
+# counter (not a gauge over instances) survives engine close/reopen.
+_h2d_lock = threading.Lock()
+_h2d_bytes_total = 0
+
+
+def note_h2d_bytes(n: int) -> None:
+    """Record `n` bytes copied host->device (call at device_put sites)."""
+    global _h2d_bytes_total
+    with _h2d_lock:
+        _h2d_bytes_total += int(n)
+
+
+def h2d_bytes_total() -> int:
+    with _h2d_lock:
+        return _h2d_bytes_total
 
 
 # -- 3. bytes-materialized model --------------------------------------------

@@ -22,6 +22,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from vearch_tpu.ops import perf_model
+
 
 def default_mesh() -> Mesh:
     """Process-wide all-devices mesh, rows on "data" (cached: mesh
@@ -72,6 +74,8 @@ def shard_rows(mesh: Mesh, x, pad_value=0):
         pad = np.full((rem,) + x.shape[1:], pad_value, dtype=x.dtype)
         x = np.concatenate([np.asarray(x), pad], axis=0)
     sharding = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+    # .nbytes is metadata on both numpy and jax arrays — no host sync
+    perf_model.note_h2d_bytes(int(getattr(x, "nbytes", 0)))
     return jax.device_put(jnp.asarray(x), sharding), n
 
 
@@ -88,6 +92,7 @@ def shard_queries(mesh: Mesh, q):
             [np.asarray(q), np.zeros((rem, q.shape[1]), dtype=q.dtype)], axis=0
         )
     sharding = NamedSharding(mesh, P("query", None))
+    perf_model.note_h2d_bytes(int(getattr(q, "nbytes", 0)))
     return jax.device_put(jnp.asarray(q), sharding), b
 
 
@@ -95,6 +100,7 @@ def replicate(mesh: Mesh, x):
     import jax.numpy as jnp
 
     spec = P(*([None] * np.ndim(x)))
+    perf_model.note_h2d_bytes(int(getattr(x, "nbytes", 0)))
     return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
 
 
@@ -190,9 +196,9 @@ class ShardedRowCache:
             self._rows = n
             rebuilt = True
             self.stats["rebuilds"] += 1
-            self.stats["h2d_bytes"] += sum(
-                np.asarray(h).nbytes for h in hosts
-            )
+            moved = sum(np.asarray(h).nbytes for h in hosts)
+            self.stats["h2d_bytes"] += moved
+            perf_model.note_h2d_bytes(moved)
         return self.arrays, rebuilt
 
     def _append(self, mesh: Mesh, n: int, cap: int, append_host_fn) -> None:
@@ -229,6 +235,7 @@ class ShardedRowCache:
                 win = tails[ai][a - lo : b - lo]
                 win_dev = jax.device_put(win, sh.device)
                 self.stats["h2d_bytes"] += win.nbytes
+                perf_model.note_h2d_bytes(win.nbytes)
                 off = np.int32(a - s * local_n)
                 if want_sq:
                     sq_sh = {
@@ -239,6 +246,7 @@ class ShardedRowCache:
                         sq_tail[a - lo : b - lo], sh.device
                     )
                     self.stats["h2d_bytes"] += sq_win.nbytes
+                    perf_model.note_h2d_bytes(sq_win.nbytes)
                     parts[s], sq_parts[s] = upd(
                         sh.data, win_dev, off, sq_sh.data, sq_win
                     )
