@@ -260,6 +260,133 @@ def _phase_emitter(cache_key: str):
     return emit, path
 
 
+def _phase_cached(partial_path: str, phase: str):
+    """Last completed partial record for ``phase``, or None. Lets an
+    expensive phase skip recompute on a resumed run — the partials file
+    IS the resume state."""
+    try:
+        with open(partial_path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, ValueError):
+        return None
+    hits = [r for r in recs if r.get("phase") == phase
+            and "error" not in r]
+    if not hits:
+        return None
+    return {k: v for k, v in hits[-1].items() if k not in ("phase", "t_s")}
+
+
+def tail_latency_bench(dry: bool) -> dict:
+    """Merged-search tail quantiles under an injected straggler,
+    hedging ON vs OFF (tail-latency tentpole). Runs an in-process
+    3-PS replica-3 cluster: the partition leader gets a killable
+    per-search delay of ~10x the observed median, then the same query
+    stream is measured through a hedging router and a hedging-disabled
+    router. The headline is the p99 ratio — and the hedge hit-rate
+    says how much extra traffic bought it."""
+    import tempfile
+
+    from vearch_tpu.cluster import rpc as _rpc
+    from vearch_tpu.cluster.router import RouterServer
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    d = 16
+    n_docs = 200
+    warm, n_meas = (25, 15) if dry else (30, 40)
+    rng = np.random.default_rng(7)
+
+    def _pctls(xs):
+        ys = sorted(xs)
+
+        def at(q):
+            i = min(len(ys) - 1, max(0, int(np.ceil(q * len(ys))) - 1))
+            return round(ys[i] * 1e3, 1)
+
+        return {"p50_ms": at(0.5), "p95_ms": at(0.95), "p99_ms": at(0.99)}
+
+    c = StandaloneCluster(
+        data_dir=tempfile.mkdtemp(prefix="vearch_tailbench_"), n_ps=3,
+        ps_kwargs={"heartbeat_interval": 0.3},
+        router_kwargs={"hedge_quantile": 0.5, "hedge_budget_pct": 100.0,
+                       "hedge_min_delay_ms": 2.0})
+    c.start()
+    off_router = RouterServer(master_addr=c.master_addr,
+                              hedge_quantile=0.0)
+    off_router.start()
+    try:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 3,
+            "fields": [{"name": "v", "data_type": "vector",
+                        "dimension": d,
+                        "index": {"index_type": "FLAT",
+                                  "metric_type": "L2", "params": {}}}],
+        })
+        vecs = rng.standard_normal((n_docs, d)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(n_docs)])
+
+        def timed(addr):
+            # unique query per call: every search really scatters
+            # (router+PS result caches never serve it)
+            q = rng.standard_normal(d).astype(np.float32)
+            t0 = time.time()
+            _rpc.call(addr, "POST", "/document/search", {
+                "db_name": "db", "space_name": "s",
+                "vectors": [{"field": "v", "feature": q.tolist()}],
+                "limit": 5,
+            })
+            return time.time() - t0
+
+        # warm both routers (and the hedging router's quantile sketch
+        # past its min-sample floor); baseline = the warm stream
+        base = [timed(c.router_addr) for _ in range(warm)]
+        for _ in range(5):
+            timed(off_router.addr)
+
+        part = cl.get_space("db", "s")["partitions"][0]
+        ps = next(p for p in c.ps_nodes if p.node_id == part["leader"])
+        p50_base_s = sorted(base)[len(base) // 2]
+        delay_ms = max(100, int(10 * p50_base_s * 1e3))
+        _rpc.call(ps.addr, "POST", "/ps/engine/config", {
+            "partition_id": part["id"],
+            "config": {"debug_search_delay_ms": delay_ms},
+        })
+        try:
+            h0 = _rpc.call(c.router_addr, "GET",
+                           "/router/stats")["hedges"]
+            hedged = [timed(c.router_addr) for _ in range(n_meas)]
+            h1 = _rpc.call(c.router_addr, "GET",
+                           "/router/stats")["hedges"]
+            unhedged = [timed(off_router.addr) for _ in range(n_meas)]
+        finally:
+            _rpc.call(ps.addr, "POST", "/ps/engine/config", {
+                "partition_id": part["id"],
+                "config": {"debug_search_delay_ms": 0},
+            })
+        fired = h1["fired"] - h0["fired"]
+        won = h1["won"] - h0["won"]
+        hp = _pctls(hedged)
+        up = _pctls(unhedged)
+        return {
+            "straggler_delay_ms": delay_ms,
+            "baseline": _pctls(base),
+            "hedged": hp,
+            "unhedged": up,
+            "hedge_fired": fired,
+            "hedge_won": won,
+            "hedge_hit_rate": round(won / fired, 3) if fired else 0.0,
+            "hedge_volume_pct": round(100.0 * fired / n_meas, 1),
+            "p99_speedup_vs_unhedged": round(
+                up["p99_ms"] / hp["p99_ms"], 2) if hp["p99_ms"] else 0.0,
+        }
+    finally:
+        off_router.stop()
+        c.stop()
+
+
 def main():
     if _dryrun():
         import jax as _jax
@@ -448,6 +575,21 @@ def main():
             perf_model.effective_qps(cold_qps_b1, hit_rate), 1),
     }
     emit("cache_effectiveness", **cache_diag)
+
+    # -- tail latency (tail-latency tentpole): merged quantiles under an
+    # injected straggler, hedging ON vs OFF, through a real in-process
+    # replica cluster. Resumable: a completed record in the partials
+    # file is reused instead of re-running the cluster. Never kills the
+    # headline.
+    tail_diag = _phase_cached(partial_path, "tail_latency")
+    if tail_diag is None:
+        try:
+            tail_diag = tail_latency_bench(_dryrun())
+        except Exception as e:
+            tail_diag = {"error": f"{type(e).__name__}: {e}"}
+        emit("tail_latency", **tail_diag)
+    else:
+        emit("tail_latency_resumed", **tail_diag)
 
     # -- per-phase breakdown (r4 review next-1: the captured headline
     # must be decomposable — where does the wall time go?) ------------
@@ -652,6 +794,7 @@ def main():
         "roofline": roofline_diag,
         "mesh_scaling": mesh_diag,
         "cache": cache_diag,
+        "tail_latency": tail_diag,
         **glove_diag,
         **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),

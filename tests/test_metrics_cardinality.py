@@ -84,9 +84,16 @@ def test_profiled_soak_does_not_grow_series(cluster, rng):
                 "vearch_ps_hbm_model_drift_bytes",
                 "vearch_ps_latency_quantile",
                 "vearch_ps_queue_depth",
-                "vearch_ps_inflight"} <= names, names
-    assert any(s.startswith("vearch_router_latency_quantile")
-               for s in baseline[cluster.router_addr])
+                "vearch_ps_inflight",
+                "vearch_ps_admission_shed_total"} <= names, names
+    rnames = {s.split("{")[0] for s in baseline[cluster.router_addr]}
+    # tail-latency series are pre-initialized (hedge events zero-filled,
+    # per-node routes zero-filled at discovery): traffic, hedges and
+    # replica routing can only move values, never mint series mid-soak
+    assert {"vearch_router_latency_quantile",
+            "vearch_router_hedges_total",
+            "vearch_router_replica_refetch_total",
+            "vearch_router_replica_route_total"} <= rnames, rnames
 
     done = BATCH
     while done < N_QUERIES:

@@ -357,6 +357,201 @@ def test_partition_split_adds_zero_dispatches_to_serving(tmp_path):
         c.stop()
 
 
+# -- gate 2c: tail-latency paths on the device ledger ------------------------
+
+
+def test_hedged_and_replica_routed_search_add_zero_device_work(tmp_path):
+    """The tail-latency contract on the device ledger: a hedged search
+    dispatches exactly the documented count ONCE (the winner's) — the
+    cancelled loser dies in its host-side wait and never reaches the
+    device — and a replica-routed (least_loaded) search is the same
+    documented dispatch sequence as a leader read. Neither compiles a
+    new program on the warmed path."""
+    import time as _time
+
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    d = 16
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2,
+                          router_kwargs={"hedge_quantile": 0.5,
+                                         "hedge_budget_pct": 100.0,
+                                         "hedge_min_delay_ms": 2.0})
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1, "replica_num": 2,
+            "fields": [
+                {"name": "v", "data_type": "vector", "dimension": d,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        rng = np.random.default_rng(21)
+        vecs = rng.standard_normal((100, d)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(100)])
+
+        def search(lb=None):
+            q = rng.standard_normal(d).astype(np.float32)
+            body = {
+                "db_name": "db", "space_name": "s",
+                "vectors": [{"field": "v", "feature": q.tolist()}],
+                "limit": 5,
+            }
+            if lb:
+                body["load_balance"] = lb
+            return rpc.call(c.router_addr, "POST", "/document/search",
+                            body)
+
+        # warm the hedge sketch past min-samples AND settle first-use
+        # programs on BOTH replicas' engines (leader + not_leader)
+        for _ in range(25):
+            search()
+        for _ in range(3):
+            search(lb="not_leader")
+
+        part = cl.get_space("db", "s")["partitions"][0]
+        ps = next(p for p in c.ps_nodes if p.node_id == part["leader"])
+        rpc.call(ps.addr, "POST", "/ps/engine/config", {
+            "partition_id": part["id"],
+            "config": {"debug_search_delay_ms": 500},
+        })
+        doc = perf_model.DOCUMENTED_DISPATCHES["flat"]
+        n = 5
+        before = perf_model.total_compiled_programs()
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            for _ in range(n):
+                out = search()
+                assert out["documents"]
+            # an un-cancelled loser would wake from its 0.5s injected
+            # wait and dispatch inside this drain window — keep the
+            # ledger armed so that bug cannot hide in a detach race
+            _time.sleep(0.8)
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+            rpc.call(ps.addr, "POST", "/ps/engine/config", {
+                "partition_id": part["id"],
+                "config": {"debug_search_delay_ms": 0},
+            })
+        stats = rpc.call(c.router_addr, "GET", "/router/stats")
+        assert stats["hedges"]["fired"] >= n, stats["hedges"]
+        assert ledger.counts() == {t: n * doc.count(t) for t in doc}, (
+            f"hedged searches launched {ledger.counts()}, documented "
+            f"{doc} x{n} — the cancelled attempt reached the device"
+        )
+        assert perf_model.total_compiled_programs() == before, (
+            "a hedged search compiled new programs on the warmed path"
+        )
+
+        # replica-routed read: identical documented dispatch sequence
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            for _ in range(n):
+                search(lb="least_loaded")
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        assert ledger.counts() == {t: n * doc.count(t) for t in doc}, (
+            f"least_loaded searches launched {ledger.counts()}"
+        )
+        assert perf_model.total_compiled_programs() == before, (
+            "a replica-routed search compiled new programs"
+        )
+    finally:
+        c.stop()
+
+
+def test_shed_request_does_zero_device_work(tmp_path):
+    """Admission shedding happens before the microbatcher and the
+    engine: a 429'd request launches zero dispatches and compiles
+    nothing — the whole point of shedding at the door."""
+    import threading as _threading
+    import time as _time
+
+    from vearch_tpu.cluster import rpc
+    from vearch_tpu.cluster.standalone import StandaloneCluster
+    from vearch_tpu.sdk.client import VearchClient
+
+    d = 16
+    c = StandaloneCluster(
+        data_dir=str(tmp_path / "c"), n_ps=1,
+        ps_kwargs={"max_concurrent_searches": 1})
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        cl.create_space("db", {
+            "name": "s", "partition_num": 1,
+            "fields": [
+                {"name": "v", "data_type": "vector", "dimension": d,
+                 "index": {"index_type": "FLAT", "metric_type": "L2",
+                           "params": {}}},
+            ],
+        })
+        rng = np.random.default_rng(22)
+        vecs = rng.standard_normal((80, d)).astype(np.float32)
+        cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                              for i in range(80)])
+
+        def search():
+            q = rng.standard_normal(d).astype(np.float32)
+            return rpc.call(c.router_addr, "POST", "/document/search", {
+                "db_name": "db", "space_name": "s",
+                "vectors": [{"field": "v", "feature": q.tolist()}],
+                "limit": 5,
+            })
+
+        search()  # settle first-use programs
+        ps = c.ps_nodes[0]
+        pid = next(iter(ps.engines))
+        rpc.call(ps.addr, "POST", "/ps/engine/config", {
+            "partition_id": pid,
+            "config": {"admission_queue_limit": 1,
+                       "debug_search_delay_ms": 2000},
+        })
+        threads = [_threading.Thread(target=search) for _ in range(2)]
+        try:
+            for t in threads:
+                t.start()
+            deadline = _time.monotonic() + 5.0
+            while ps._admission.waiting < 1:
+                assert _time.monotonic() < deadline
+                _time.sleep(0.01)
+            # gate holder is pinned in its 2s injected wait and the
+            # admission slot is full: the shed below resolves while
+            # both are still parked, so the armed ledger can only see
+            # the shed request itself
+            before = perf_model.total_compiled_programs()
+            ledger = perf_model.PerfLedger()
+            ivf_ops.set_dispatch_ledger(ledger)
+            try:
+                with pytest.raises(rpc.RpcError) as ei:
+                    search()
+            finally:
+                ivf_ops.set_dispatch_ledger(None)
+            assert ei.value.code == 429
+            assert ledger.tags == [], (
+                f"a shed request reached the device: {ledger.tags}"
+            )
+            assert perf_model.total_compiled_programs() == before
+        finally:
+            for t in threads:
+                t.join(timeout=15.0)
+            rpc.call(ps.addr, "POST", "/ps/engine/config", {
+                "partition_id": pid,
+                "config": {"admission_queue_limit": 0,
+                           "debug_search_delay_ms": 0},
+            })
+    finally:
+        c.stop()
+
+
 # -- gate 3: bytes materialized ----------------------------------------------
 
 

@@ -148,6 +148,10 @@ def main(argv: list[str] | None = None) -> int:
             trace_collector=cfg_tr.get("collector_endpoint"),
             search_cache_entries=int(
                 cfg_ps.get("search_cache_entries", 256)),
+            # overload shedding bound (0 disables; runtime-tunable via
+            # /ps/engine/config)
+            admission_queue_limit=int(
+                cfg_ps.get("admission_queue_limit", 0)),
         )
         server.start()
         print(f"ps node {server.node_id}: http://{server.addr}", flush=True)
@@ -179,6 +183,11 @@ def main(argv: list[str] | None = None) -> int:
         fanout_workers=int(cfg_rt.get("fanout_workers", 0)),
         cache_entries=int(cfg_rt.get("cache_entries", 512)),
         cache_ttl_s=float(cfg_rt.get("cache_ttl_s", 10.0)),
+        # tail-latency knobs: adaptive hedged scatter (quantile-derived
+        # delay, budget-capped) and least-loaded replica reads
+        hedge_quantile=float(cfg_rt.get("hedge_quantile", 0.95)),
+        hedge_budget_pct=float(cfg_rt.get("hedge_budget_pct", 10.0)),
+        replica_read=bool(cfg_rt.get("replica_read", False)),
     )
     server.start()
     print(f"router: http://{server.addr}", flush=True)

@@ -20,11 +20,15 @@ Example:
     fanout_workers = 0        # 0 = auto-size with partition count
     cache_entries = 512       # merged-result cache; 0 disables
     cache_ttl_s = 10.0        # safety net for unseen writers
+    hedge_quantile = 0.95     # adaptive hedge delay quantile; 0 disables
+    hedge_budget_pct = 10.0   # hedges stay <= this % of scatter RPCs
+    replica_read = false      # reads to the least-loaded live replica
 
     [ps]
     port = 8081
     max_concurrent_searches = 256
     search_cache_entries = 256  # partition result cache; 0 disables
+    admission_queue_limit = 0   # shed (429) past this many waiters; 0 off
 """
 
 from __future__ import annotations
@@ -81,6 +85,18 @@ class Config:
         sce = self.ps.get("search_cache_entries")
         if sce is not None and int(sce) < 0:
             raise ValueError("[ps] search_cache_entries must be >= 0")
+        hq = self.router.get("hedge_quantile")
+        if hq is not None and not (0.0 <= float(hq) < 1.0):
+            raise ValueError("[router] hedge_quantile must be in [0, 1) "
+                             "(0 disables hedging)")
+        hb = self.router.get("hedge_budget_pct")
+        if hb is not None and not (0.0 <= float(hb) <= 100.0):
+            raise ValueError("[router] hedge_budget_pct must be in "
+                             "[0, 100]")
+        aql = self.ps.get("admission_queue_limit")
+        if aql is not None and int(aql) < 0:
+            raise ValueError("[ps] admission_queue_limit must be >= 0 "
+                             "(0 disables shedding)")
 
     @property
     def data_dir(self) -> str:

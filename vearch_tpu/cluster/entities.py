@@ -204,6 +204,12 @@ class Server:
     # topology labels for replica anti-affinity (reference:
     # config.go:389 strategies 0-3: none/host/rack/zone)
     labels: dict[str, str] = field(default_factory=dict)
+    # load summary riding the PS heartbeat (search queue depth,
+    # inflight, latency quantiles): merged into /servers by the master
+    # from its in-memory heartbeat state — never persisted, so the
+    # metastore is not churned once per heartbeat. Routers score
+    # replicas with it for least-loaded read routing.
+    load: dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
         return dict(self.__dict__)

@@ -262,6 +262,13 @@ class MasterServer:
         # {hbm_drift, drift_bytes, compiles_post_warmup} from the PS
         # device sampler + compile flight recorder
         self._node_obs: dict[int, dict] = {}
+        # per-node load summary (search queue depth / inflight /
+        # latency quantiles) riding the same heartbeat, merged into
+        # /servers so routers can pick the least-loaded replica; also
+        # in-memory only — it changes every heartbeat, persisting it
+        # would churn the metastore (and fire every watch) at 0.5Hz
+        # times the fleet size
+        self._node_loads: dict[int, dict] = {}
         self._register_cluster_gauges()
 
         if self.replicated:
@@ -662,6 +669,7 @@ class MasterServer:
                         # show stale numbers for the process lifetime
                         self._node_stats.pop(node_id, None)
                         self._node_obs.pop(node_id, None)
+                        self._node_loads.pop(node_id, None)
                         self._failover_node(node_id)
             except Exception as e:
                 # store mutations propose through the meta log and can
@@ -1300,6 +1308,8 @@ class MasterServer:
             self._node_stats[node_id] = body["partitions"] or {}
         if "obs" in body:
             self._node_obs[node_id] = body["obs"] or {}
+        if "load" in body:
+            self._node_loads[node_id] = body["load"] or {}
         # field-index + schema expectations for the partitions this node
         # hosts: heals replicas that missed a /field_index or
         # /ps/schema/field fan-out (transient RPC failure, or a restart
@@ -1317,7 +1327,15 @@ class MasterServer:
                 }}
 
     def _h_servers(self, _body, _parts) -> dict:
-        return {"servers": list(self.store.prefix(PREFIX_SERVER).values())}
+        # merge the live heartbeat load into each record at read time:
+        # the stored record stays heartbeat-stable (watch-quiet) while
+        # routers still see queue depth / latency fresh to within one
+        # heartbeat interval
+        servers = []
+        for d in self.store.prefix(PREFIX_SERVER).values():
+            load = self._node_loads.get(int(d.get("node_id", -1)))
+            servers.append({**d, "load": load} if load else dict(d))
+        return {"servers": servers}
 
     def _alive_servers(self) -> list[Server]:
         return [

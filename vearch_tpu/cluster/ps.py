@@ -162,6 +162,7 @@ class PSServer:
         device_sample_interval: float = 5.0,
         hbm_drift_tolerance: float = 0.5,
         hbm_drift_slack_mb: int = 64,
+        admission_queue_limit: int = 0,
     ):
         from vearch_tpu.utils import apply_jax_platform_env
 
@@ -189,6 +190,7 @@ class PSServer:
         # concurrency gate (reference: RequestConcurrentController,
         # search/engine.h:197; rpcx request concurrency, ps/server.go:89)
         self._search_gate = threading.BoundedSemaphore(max_concurrent_searches)
+        self.max_concurrent_searches = max_concurrent_searches
         # 0 = unlimited (reference: resource-limit write guard,
         # store_writer.go:82-95 -> partition flips read-only)
         self.memory_limit_mb = memory_limit_mb
@@ -265,6 +267,19 @@ class PSServer:
         )
         self._search_ewma: dict[int, float] = {}  # pid -> ms
         self.slow_routed = 0
+        # admission control (tail-latency tentpole): bounded wait queue
+        # in front of the search gates — when more than
+        # admission_queue_limit requests are already waiting, new
+        # arrivals shed with 429 + Retry-After instead of queueing past
+        # the point anyone will wait. 0 disables (default). Runtime-
+        # tunable via /ps/engine/config {"admission_queue_limit": n}.
+        from vearch_tpu.cluster.admission import AdmissionController
+
+        self._admission = AdmissionController(admission_queue_limit)
+        # fault injection for tail-latency tests/bench: every search
+        # sleeps this long (killable, in deadline-check chunks) before
+        # touching the engine. Set via /ps/engine/config.
+        self.debug_search_delay_ms = 0
         # PS-tier result cache + coalescing (perf tentpole: the
         # cheapest dispatch is the one never issued). Keys embed
         # (partition, canonical query, raft apply index, engine data
@@ -417,6 +432,12 @@ class PSServer:
             "in-flight requests aborted, by reason "
             "(deadline/slow/operator)",
             ("reason",))
+        self._shed_total = m.counter(
+            "vearch_ps_admission_shed_total",
+            "requests shed (429) by admission control before any "
+            "device work, per op",
+            ("op",))
+        self._shed_total.inc("search", by=0.0)  # render from 1st scrape
         self._wal_fsync_hist = m.histogram(
             "vearch_wal_fsync_latency_seconds",
             "WAL fsync wall time per append batch",
@@ -820,6 +841,35 @@ class PSServer:
             "compiles_post_warmup": self.flight_recorder.total(),
         }
 
+    def _load_summary(self) -> dict:
+        """Search-path load digest riding the heartbeat: queue depth,
+        inflight, and node latency quantiles. The master merges it into
+        /servers (in-memory only) so routers can score replicas for
+        least-loaded read routing without polling each PS."""
+        with self._stats_lock:
+            waiting = int(self._op_waiting.get("search", 0))
+            inflight = int(self._op_inflight.get("search", 0))
+        q = (self.latency_quantiles.snapshot()
+             .get(("_node", "search")) or {}).get("q", {})
+        return {
+            "waiting": waiting,
+            "inflight": inflight,
+            "q50_ms": float(q.get("0.5", 0.0)),
+            "q95_ms": float(q.get("0.95", 0.0)),
+        }
+
+    def _retry_after_s(self) -> float:
+        """Backpressure hint for 429 sheds: a rough time-to-drain —
+        median search latency times queue depth over service capacity,
+        clamped so clients neither hammer (floor) nor give up (cap)."""
+        q = (self.latency_quantiles.snapshot()
+             .get(("_node", "search")) or {}).get("q", {})
+        q50_s = float(q.get("0.5", 0.0)) / 1e3 or 0.05
+        with self._stats_lock:
+            waiting = int(self._op_waiting.get("search", 0))
+        est = q50_s * (waiting + 1) / max(1, self.max_concurrent_searches)
+        return round(min(5.0, max(0.05, est)), 3)
+
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
             time.sleep(self.heartbeat_interval)
@@ -831,7 +881,9 @@ class PSServer:
                      "partitions": self._partition_stats(),
                      # runtime-truth digest: the master's health
                      # rollup degrades on drift without polling us
-                     "obs": self._obs_summary()},
+                     "obs": self._obs_summary(),
+                     # load digest for least-loaded replica routing
+                     "load": self._load_summary()},
                     auth=self.master_auth,
                 )
             except RpcError:
@@ -1543,14 +1595,21 @@ class PSServer:
         """Kill in-flight request(s) by id (reference: SetKillStatus).
         A retried request may share its id with the original — kill
         every matching entry (the registry is keyed by a unique token
-        so duplicates never shadow each other)."""
+        so duplicates never shadow each other). An optional "attempt"
+        narrows the kill to one hedged-scatter attempt: the rid is
+        shared across a whole fan-out, so the router cancelling a
+        hedge loser must not take out the sibling partitions' RPCs."""
         rid = str(body["request_id"])
+        att = body.get("attempt")
         killed = 0
         with self._inflight_lock:
             for info in self._inflight.values():
-                if info["rid"] == rid and not info["ctx"].killed:
-                    info["ctx"].kill("killed by operator", code="operator")
-                    killed += 1
+                if info["rid"] != rid or info["ctx"].killed:
+                    continue
+                if att is not None and info.get("attempt") != att:
+                    continue
+                info["ctx"].kill("killed by operator", code="operator")
+                killed += 1
             self.killed_requests += killed
         if not killed:
             raise RpcError(404, f"request {rid!r} not in flight")
@@ -1610,6 +1669,18 @@ class PSServer:
         if slow:
             with self._stats_lock:
                 self.slow_routed += 1
+        # admission control: shed before joining a wait queue that is
+        # already past the bound — the request does zero device work and
+        # the 429 carries a Retry-After estimate for the SDK's backoff
+        if not self._admission.try_admit(
+                priority=int(body.get("priority") or 0)):
+            self._shed_total.inc("search")
+            raise RpcError(
+                429,
+                f"partition server shedding: admission queue full "
+                f"(limit {self._admission.queue_limit})",
+                retry_after=self._retry_after_s(),
+            )
         t_gate = time.monotonic()
         with self._stats_lock:
             self._op_waiting["search"] += 1
@@ -1618,11 +1689,13 @@ class PSServer:
         finally:
             with self._stats_lock:
                 self._op_waiting["search"] -= 1
+            self._admission.leave()
         if not acquired:
             raise RpcError(
                 429,
                 "partition server %s queue full"
                 % ("slow-search" if slow else "search"),
+                retry_after=self._retry_after_s(),
             )
         with self._stats_lock:
             self._op_inflight["search"] += 1
@@ -1644,7 +1717,12 @@ class PSServer:
         with self._inflight_lock:
             self._inflight[token] = {"rid": rid, "start": t_start,
                                      "ctx": ctx, "slow": slow,
-                                     "deadline": ctx.deadline}
+                                     "deadline": ctx.deadline,
+                                     # hedged-scatter attempt id: lets
+                                     # the router cancel one attempt of
+                                     # a fan-out without killing the
+                                     # sibling that shares the rid
+                                     "attempt": body.get("_hedge_attempt")}
         from vearch_tpu.cluster.tracing import NULL_SPAN
 
         tctx = body.get("_trace_ctx")
@@ -1670,6 +1748,16 @@ class PSServer:
         _trace_token = _flightrec.set_active_trace(span.trace_id or rid)
         try:
             with span:
+                if self.debug_search_delay_ms:
+                    # injected straggler (tests/bench): sleep in small
+                    # chunks so a hedged loser's kill aborts it fast
+                    end = t_start + float(self.debug_search_delay_ms) / 1e3
+                    while True:
+                        ctx.check()
+                        rem = end - time.monotonic()
+                        if rem <= 0:
+                            break
+                        time.sleep(min(0.005, rem))
                 # apply version captured BEFORE the search runs: a
                 # write landing mid-search makes the resulting cache
                 # entry *older*-labeled, so it can never serve a state
@@ -2478,6 +2566,17 @@ class PSServer:
             self.search_cache.max_entries = n
             if n <= 0:
                 self.search_cache.clear()
+        if "admission_queue_limit" in cfg:
+            # runtime-tunable shed bound; 0 disables shedding
+            n = int(cfg["admission_queue_limit"])
+            if n < 0:
+                raise RpcError(400,
+                               "admission_queue_limit must be >= 0")
+            self._admission.queue_limit = n
+        if "debug_search_delay_ms" in cfg:
+            # fault injection (tail-latency tests/bench): per-search
+            # killable sleep before any engine work
+            self.debug_search_delay_ms = int(cfg["debug_search_delay_ms"])
         if "log_level" in cfg:
             # runtime log-level flip, fanned out by the master's /config
             # (reference: log-level runtime config in pkg/log)
@@ -2692,6 +2791,9 @@ class PSServer:
                 for key, rec in self.latency_quantiles.snapshot().items()
             },
             "op_load": op_load,
+            # admission-control counters (sheds, waiters, limit) — the
+            # doctor's shed-rate check reads these
+            "admission": self._admission.snapshot(),
             # snapshot under no lock: stale reads are fine for stats
             "search_ewma_ms": {
                 str(pid): round(ms, 2)

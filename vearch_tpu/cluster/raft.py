@@ -909,15 +909,23 @@ class RaftNode:
         snap_term = body.get("snap_term")
         with self._apply_lock:
             with self._lock:
-                if snap_index <= self.applied:
+                if snap_index <= self.wal.commit_index:
                     # stale stream (raft: ignore InstallSnapshot at or
-                    # below our applied index): a delayed/duplicated
-                    # same-term snapshot must not REWIND a follower that
-                    # already advanced past it via appends — the rewind
-                    # transiently un-applies committed entries (caught
-                    # by the adversarial suite as a vanished acked op).
-                    # success=True so the leader stops re-streaming; its
-                    # next append probe resynchronizes next_index.
+                    # below our COMMIT index, not just applied): a
+                    # delayed/duplicated snapshot must not rewind a
+                    # follower that already advanced past it via
+                    # appends. Guarding only `applied` leaves a window
+                    # when the apply loop lags (applied < snap_index <=
+                    # commit): the wal.reset below would then DISCARD
+                    # committed — possibly acked — entries above
+                    # snap_index and rewind commit_index past them
+                    # (caught by the adversarial suite as a vanished
+                    # acked op). Committed prefixes never diverge, so
+                    # the snapshot's content is already a prefix of our
+                    # committed log — the apply loop catches up on its
+                    # own. success=True so the leader stops
+                    # re-streaming; the last_index we return (and its
+                    # next append probe) resynchronizes next_index.
                     return {"success": True, "term": self.term,
                             "last_index": self.wal.last_index,
                             "stale": True}

@@ -39,6 +39,11 @@ class RouterServer:
         fanout_workers: int = 0,
         cache_entries: int = 512,
         cache_ttl_s: float = 10.0,
+        hedge_quantile: float = 0.95,
+        hedge_budget_pct: float = 10.0,
+        replica_read: bool = False,
+        hedge_min_delay_ms: float = 10.0,
+        hedge_max_delay_ms: float = 2000.0,
     ):
         from vearch_tpu.cluster.tracing import SlowLog, Tracer
 
@@ -92,6 +97,35 @@ class RouterServer:
 
         self.latency_quantiles = QuantileRegistry(
             name="router.quantiles")
+        # adaptive hedged scatter (tail-latency tentpole): when a
+        # partition RPC outlives the partition's own observed tail (the
+        # configured quantile of its scatter sketch, clamped to
+        # [min, max] delay), a second attempt fires at a DIFFERENT live
+        # replica; first success wins and the loser is cancelled
+        # through /ps/kill. hedge_quantile == 0 disables. The token
+        # bucket keeps hedges under hedge_budget_pct of primary scatter
+        # volume so a cluster-wide slowdown cannot double its own load.
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_budget_pct = float(hedge_budget_pct)
+        self.hedge_min_delay_ms = float(hedge_min_delay_ms)
+        self.hedge_max_delay_ms = float(hedge_max_delay_ms)
+        # no hedging off a cold sketch: the first requests against a
+        # partition carry no tail evidence worth acting on
+        self.hedge_min_samples = 20
+        self._hedge_lock = threading.Lock()
+        self._hedge_token_cap = 10.0  # burst allowance
+        self._hedge_tokens = self._hedge_token_cap
+        self.hedge_stats = {"fired": 0, "won": 0, "cancelled": 0,
+                            "budget_denied": 0}
+        # load-aware replica reads: when on, reads without an explicit
+        # load_balance go to the least-loaded live replica, scored from
+        # the queue/latency digest each PS heartbeats to the master
+        self.replica_read = bool(replica_read)
+        # per-destination-node RPC counts (topology-bounded labels);
+        # _servers() zero-fills newly seen nodes so the series exist
+        # from the first metadata fetch, not the first routed request
+        self._route_lock = threading.Lock()
+        self._route_counts: dict[int, int] = {}
         self._part_versions: dict[int, int] = {}
         self._part_versions_lock = threading.Lock()
         # partition-map hot reload (elasticity): newest map version
@@ -212,6 +246,28 @@ class RouterServer:
             "streaming tail-latency quantiles of per-partition scatter "
             "RPCs as this router sees them (P^2 sketch, ms)",
             ("op", "q"), _router_quantiles)
+        self._m_hedges = m.counter(
+            "vearch_router_hedges_total",
+            "hedged scatter attempts by event (fired/won/cancelled/"
+            "budget_denied)", ("event",))
+        for e in ("fired", "won", "cancelled", "budget_denied"):
+            self._m_hedges.inc(e, by=0.0)
+        self._m_replica_refetch = m.counter(
+            "vearch_router_replica_refetch_total",
+            "replica answers discarded for a stale apply_version and "
+            "re-fetched from the leader (read-your-writes guard)", ())
+        self._m_replica_refetch.inc(by=0.0)
+
+        def _route_series():
+            with self._route_lock:
+                return {(str(n),): float(c)
+                        for n, c in self._route_counts.items()}
+
+        m.callback_counter(
+            "vearch_router_replica_route_total",
+            "partition RPCs routed per destination node (hedges "
+            "included) — the replica-routing decision audit",
+            ("node",), _route_series)
 
     def start(self) -> None:
         self.server.start()
@@ -325,6 +381,11 @@ class RouterServer:
             f"{key[0]}/{key[1]}": rec
             for key, rec in self.latency_quantiles.snapshot().items()
         }
+        with self._hedge_lock:
+            hedges = dict(self.hedge_stats)
+            hedge_tokens = round(self._hedge_tokens, 2)
+        with self._route_lock:
+            routes = {str(n): c for n, c in self._route_counts.items()}
         with self._cache_lock:
             return {
                 "watch_rev": self._watch_rev,
@@ -342,6 +403,9 @@ class RouterServer:
                     **self.result_cache.stats,
                 },
                 "latency_quantiles": quant,
+                "hedges": hedges,
+                "hedge_tokens": hedge_tokens,
+                "replica_routes": routes,
             }
 
     def _h_cache_invalidate(self, body, _parts) -> dict:
@@ -519,20 +583,32 @@ class RouterServer:
         servers = {
             s["node_id"]: Server.from_dict(s) for s in data["servers"]
         }
+        with self._route_lock:
+            # zero-fill route counters so the per-node series render
+            # from the first scrape after discovery (cardinality-soak
+            # contract: traffic moves values, never label sets)
+            for nid in servers:
+                self._route_counts.setdefault(nid, 0)
         with self._cache_lock:
             self._server_cache = (now, servers)
         return servers
 
     def _partition_target(
-        self, space: Space, partition_id: int, load_balance: str = "leader"
+        self, space: Space, partition_id: int,
+        load_balance: str = "leader",
+        exclude: tuple = (),
     ) -> tuple[int, str]:
         """Pick a replica for the RPC (reference: client/ps.go:33-39
-        clientType LEADER/NOTLEADER/RANDOM). Writes always go to the
+        clientType LEADER/NOTLEADER/RANDOM, plus "least_loaded" scored
+        from the heartbeat load digest). Writes always go to the
         leader; reads may spread across replicas (replication is
         synchronous, so followers serve the same committed state).
         Read balancing skips nodes under a faulty penalty; the leader is
         never skipped for leader-targeted calls — correctness over
-        availability there, and the failover retry handles a dead one."""
+        availability there, and the failover retry handles a dead one.
+        A non-empty ``exclude`` marks a hedge attempt: it must land on
+        a different node than the primary, picking the least-loaded of
+        what remains (503 when nothing remains — the hedge just loses)."""
         import random
 
         servers = self._servers()
@@ -550,16 +626,43 @@ class RouterServer:
         healthy = [r for r in candidates
                    if self._faulty.get(r, 0.0) <= now]
         node = leader
-        if load_balance == "random" and candidates:
+        if exclude:
+            pool = [r for r in (healthy or candidates)
+                    if r not in exclude]
+            if not pool:
+                raise RpcError(503, f"no alternate replica for "
+                                    f"partition {partition_id}")
+            node = self._pick_least_loaded(servers, pool)
+        elif load_balance == "random" and candidates:
             node = random.choice(healthy or candidates)
         elif load_balance == "not_leader":
             followers = [r for r in (healthy or candidates) if r != leader]
             if followers:
                 node = random.choice(followers)
+        elif load_balance == "least_loaded" and candidates:
+            node = self._pick_least_loaded(servers, healthy or candidates)
         srv = servers.get(node)
         if srv is None:
             raise RpcError(503, f"no server for partition {partition_id}")
         return node, srv.rpc_addr
+
+    @staticmethod
+    def _pick_least_loaded(servers: dict[int, Server],
+                           pool: list[int]) -> int:
+        """Score replicas by the load digest their PS heartbeats to the
+        master (queue depth + inflight, weighted by the node's own q95):
+        lowest wins, ties break randomly so equal nodes share traffic.
+        Nodes without a digest yet (just joined, old PS) score neutral."""
+        import random
+
+        def score(n: int) -> float:
+            load = servers[n].load if n in servers else {}
+            depth = (float(load.get("waiting", 0))
+                     + float(load.get("inflight", 0)))
+            return (1.0 + depth) * (1.0 + float(load.get("q95_ms", 0.0)))
+
+        best = min(score(n) for n in pool)
+        return random.choice([n for n in pool if score(n) == best])
 
     def _invalidate_caches(self) -> None:
         with self._cache_lock:
@@ -567,7 +670,8 @@ class RouterServer:
             self._server_cache = (0.0, {})
 
     def _call_partition(self, space_key: tuple[str, str], pid: int,
-                        path: str, body: dict, load_balance: str = "leader"):
+                        path: str, body: dict, load_balance: str = "leader",
+                        exclude: tuple = (), on_target=None):
         """RPC to a partition replica with one failover retry: an
         unreachable node triggers a metadata refresh (the master may
         have promoted a replica) and a second attempt against the leader
@@ -600,7 +704,15 @@ class RouterServer:
                     # replica instead of forcing reads onto a possibly
                     # dead leader mid-failover
                     lb = "leader"
-                node, addr = self._partition_target(space, pid, lb)
+                node, addr = self._partition_target(space, pid, lb,
+                                                    exclude=exclude)
+                if on_target is not None:
+                    # publish the pick before the RPC blocks: the hedge
+                    # coordinator reads it to aim elsewhere / cancel
+                    on_target(node)
+                with self._route_lock:
+                    self._route_counts[node] = (
+                        self._route_counts.get(node, 0) + 1)
                 out = rpc.call(addr, "POST", path,
                                {**body, "partition_id": pid})
                 with self._cache_lock:
@@ -616,6 +728,195 @@ class RouterServer:
                     raise
                 last = e
         raise last
+
+    # -- adaptive hedged scatter (tail-latency tentpole) ---------------------
+
+    def _hedge_note(self, event: str) -> None:
+        self._m_hedges.inc(event)
+        with self._hedge_lock:
+            self.hedge_stats[event] += 1
+
+    def _hedge_credit(self) -> None:
+        """Every primary scatter RPC earns a fraction of a hedge token:
+        sustained hedge volume can never exceed hedge_budget_pct of
+        primary volume (plus the small burst the cap allows)."""
+        with self._hedge_lock:
+            self._hedge_tokens = min(
+                self._hedge_token_cap,
+                self._hedge_tokens + self.hedge_budget_pct / 100.0)
+
+    def _hedge_debit(self) -> bool:
+        with self._hedge_lock:
+            if self._hedge_tokens >= 1.0:
+                self._hedge_tokens -= 1.0
+                return True
+            return False
+
+    def _hedge_delay_ms(self, skey: tuple[str, str],
+                        pid: int) -> float | None:
+        """The adaptive hedge delay for this partition, or None when
+        hedging is ineligible: disabled, fewer than two live replicas
+        to race, or too few samples to call anything a straggler. The
+        delay is the partition's own observed tail — the configured
+        quantile of its scatter sketch (node-level sketch as fallback),
+        clamped to [hedge_min_delay_ms, hedge_max_delay_ms]."""
+        if self.hedge_quantile <= 0.0:
+            return None
+        try:
+            space = self._space(*skey)
+            servers = self._servers()
+        except RpcError:
+            return None  # metadata unavailable: the plain path copes
+        part = next((p for p in space.partitions if p.id == pid), None)
+        if part is None or len(
+                [r for r in part.replicas if r in servers]) < 2:
+            return None
+        from vearch_tpu.obs.quantiles import _qlabel
+
+        snap = self.latency_quantiles.snapshot()
+        lbl = _qlabel(self.hedge_quantile)
+        for key in ((pid, "scatter"), ("_node", "scatter")):
+            rec = snap.get(key)
+            if rec and rec.get("count", 0) >= self.hedge_min_samples:
+                q = float(rec["q"].get(lbl)
+                          or rec["q"].get("0.95") or 0.0)
+                return min(self.hedge_max_delay_ms,
+                           max(self.hedge_min_delay_ms, q))
+        return None
+
+    def _scatter_call(self, skey: tuple[str, str], pid: int,
+                      sub: dict, lb: str) -> dict:
+        """One partition's search RPC with both tail defenses: adaptive
+        hedging (second attempt on another replica once the RPC
+        outlives the observed tail; first success wins, loser is
+        killed) and the replica staleness guard (an answer whose
+        apply_version predates a write this router already acknowledged
+        is treated like a version-mismatched cache entry: discarded and
+        re-fetched from the leader — read-your-writes holds under
+        replica routing)."""
+        with self._part_versions_lock:
+            known = self._part_versions.get(pid, -1)
+        delay_ms = self._hedge_delay_ms(skey, pid)
+        if delay_ms is None:
+            target: dict = {}
+            r = self._call_partition(
+                skey, pid, "/ps/doc/search", sub, lb,
+                on_target=lambda n: target.update(n=n))
+            r["_served_by"] = target.get("n")
+            r["_hedge"] = "none"
+        else:
+            r = self._hedged_call(skey, pid, sub, lb, delay_ms)
+        av = r.get("apply_version")
+        if av is not None and int(av) < known:
+            self._m_replica_refetch.inc()
+            target = {}
+            r2 = self._call_partition(
+                skey, pid, "/ps/doc/search", sub, "leader",
+                on_target=lambda n: target.update(n=n))
+            r2["_served_by"] = target.get("n")
+            r2["_hedge"] = r["_hedge"]
+            return r2
+        return r
+
+    def _hedged_call(self, skey: tuple[str, str], pid: int, sub: dict,
+                     lb: str, delay_ms: float) -> dict:
+        """Race a primary attempt against a (budget-gated) hedge on a
+        different replica. Both attempts share the request id (so an
+        operator kill-by-rid still reaches them) but carry distinct
+        _hedge_attempt markers, so cancelling the loser cannot kill
+        sibling partition RPCs of the same fan-out. A kill-induced 499
+        on the loser is discarded here — it never double-counts and
+        never propagates once a winner exists."""
+        import uuid
+
+        rid = str(sub.get("request_id") or uuid.uuid4().hex)
+        self._hedge_credit()  # primary volume feeds the budget
+        done = threading.Event()
+        lock = threading.Lock()
+        box: dict = {"winner": None, "errors": {}, "pending": 1,
+                     "nodes": {}}
+
+        def run(slot: str, att: str, exclude: tuple) -> None:
+            try:
+                out = self._call_partition(
+                    skey, pid, "/ps/doc/search",
+                    {**sub, "request_id": rid, "_hedge_attempt": att},
+                    lb, exclude=exclude,
+                    on_target=lambda n: box["nodes"].__setitem__(slot, n),
+                )
+                with lock:
+                    if box["winner"] is None:
+                        box["winner"] = (slot, out)
+            except RpcError as e:
+                with lock:
+                    box["errors"][slot] = e
+            finally:
+                with lock:
+                    box["pending"] -= 1
+                    finished = (box["winner"] is not None
+                                or box["pending"] == 0)
+                if finished:
+                    done.set()
+
+        att1, att2 = uuid.uuid4().hex, uuid.uuid4().hex
+        threading.Thread(target=run, args=("primary", att1, ()),
+                         name="router-scatter-primary",
+                         daemon=True).start()
+        fired = False
+        if not done.wait(delay_ms / 1e3):
+            if self._hedge_debit():
+                fired = True
+                self._hedge_note("fired")
+                exclude = tuple(
+                    n for n in (box["nodes"].get("primary"),)
+                    if n is not None)
+                with lock:
+                    box["pending"] += 1
+                threading.Thread(target=run, args=("hedge", att2, exclude),
+                                 name="router-scatter-hedge",
+                                 daemon=True).start()
+            else:
+                self._hedge_note("budget_denied")
+        done.wait()
+        with lock:
+            winner = box["winner"]
+            err = (box["errors"].get("primary")
+                   or box["errors"].get("hedge"))
+        if winner is None:
+            raise err  # both attempts failed: the primary's error wins
+        slot, out = winner
+        out["_served_by"] = box["nodes"].get(slot)
+        out["_hedge"] = ("hedge_won" if slot == "hedge"
+                         else ("fired" if fired else "none"))
+        if slot == "hedge":
+            self._hedge_note("won")
+        if fired:
+            loser = "hedge" if slot == "primary" else "primary"
+            self._cancel_attempt(rid, att2 if loser == "hedge" else att1,
+                                 box["nodes"].get(loser))
+        return out
+
+    def _cancel_attempt(self, rid: str, att: str, node) -> None:
+        """Fire-and-forget kill of a hedge loser, narrowed to its
+        attempt id. A 404 means the loser already finished — nothing
+        left to cancel, nothing to report."""
+        if node is None:
+            return
+
+        def kill() -> None:
+            try:
+                srv = self._servers().get(node)
+                if srv is None:
+                    return
+                out = rpc.call(srv.rpc_addr, "POST", "/ps/kill",
+                               {"request_id": rid, "attempt": att})
+                if out.get("killed"):
+                    self._hedge_note("cancelled")
+            except RpcError:
+                pass
+
+        threading.Thread(target=kill, name="router-hedge-cancel",
+                         daemon=True).start()
 
     def _authenticate(self, headers, method, path) -> None:
         """BasicAuth via the master's /auth/check (positively cached 5s)
@@ -1084,7 +1385,10 @@ class RouterServer:
             "cache": body.get("cache", True) is not False,
         }
 
-        lb = body.get("load_balance", "leader")
+        # replica_read flips the default read routing to the least-
+        # loaded live replica; an explicit load_balance always wins
+        lb = body.get("load_balance") or (
+            "least_loaded" if self.replica_read else "leader")
 
         from vearch_tpu.cluster.tracing import NULL_SPAN
 
@@ -1210,6 +1514,8 @@ class RouterServer:
                 out["profile"] = {
                     "partitions": {
                         str(pid): {"rpc_ms": r["_rpc_ms"],
+                                   "hedge": r.get("_hedge", "none"),
+                                   "served_by": r.get("_served_by"),
                                    **(r.get("profile") or {})}
                         for pid, r in results
                     },
@@ -1261,9 +1567,10 @@ class RouterServer:
             else:
                 span, body_p = NULL_SPAN, sub
             with span:
-                r = self._call_partition(
-                    skey, pid, "/ps/doc/search", body_p, lb
-                )
+                r = self._scatter_call(skey, pid, body_p, lb)
+                span.set_tag("hedge", r.get("_hedge", "none"))
+                if r.get("_served_by") is not None:
+                    span.set_tag("served_by", r["_served_by"])
             # every partial carries the partition's apply version —
             # feed the router's validity map even on plain searches
             self._note_apply_version(pid, r.get("apply_version"))
@@ -1485,7 +1792,8 @@ class RouterServer:
                                     self._partition_of_keys(space, keys_in)):
                     by_partition.setdefault(pid, []).append(key)
 
-            lb = body.get("load_balance", "leader")
+            lb = body.get("load_balance") or (
+                "least_loaded" if self.replica_read else "leader")
 
             def send(pid: int, keys: list[str]):
                 return self._call_partition(
@@ -1536,7 +1844,8 @@ class RouterServer:
                  "sort": sort_specs or None,
                  "raft_consistent": bool(body.get("raft_consistent", False)),
                  "vector_value": body.get("vector_value", False)},
-                body.get("load_balance", "leader"))
+                body.get("load_balance") or (
+                    "least_loaded" if self.replica_read else "leader"))
 
         # explicit partition_id = a sampling read of ONE partition
         # (reference: doc_query.go query-by-partition — inspect a

@@ -180,10 +180,15 @@ def _decode(content_type: str, raw: bytes) -> Any:
 
 
 class RpcError(Exception):
-    def __init__(self, code: int, msg: str):
+    def __init__(self, code: int, msg: str,
+                 retry_after: float | None = None):
         super().__init__(msg)
         self.code = code
         self.msg = msg
+        # overload backpressure hint (seconds): set on 429 sheds so the
+        # SDK can back off for exactly as long as the server asked
+        # instead of guessing; rides the error payload end to end
+        self.retry_after = retry_after
 
 
 def _sample_profile(seconds: float, interval: float = 0.01) -> str:
@@ -440,7 +445,10 @@ class JsonRpcServer:
                     self._reply(200, {"code": 0, "data": result})
                 except RpcError as e:
                     code = e.code
-                    self._reply(200, {"code": e.code, "msg": e.msg})
+                    payload = {"code": e.code, "msg": e.msg}
+                    if e.retry_after is not None:
+                        payload["retry_after"] = float(e.retry_after)
+                    self._reply(200, payload)
                 except Exception as e:  # panic recovery
                     code = 500
                     _log.error("panic in %s %s: %s: %s\n%s", method,
@@ -589,7 +597,9 @@ def call(
     else:
         payload = _decode(resp_ct, raw)
     if payload.get("code", 0) != 0:
-        raise RpcError(payload["code"], payload.get("msg", "rpc error"))
+        ra = payload.get("retry_after")
+        raise RpcError(payload["code"], payload.get("msg", "rpc error"),
+                       retry_after=float(ra) if ra is not None else None)
     return payload.get("data")
 
 

@@ -21,6 +21,7 @@ class StandaloneCluster:
         data_dir: str | None = None,
         n_ps: int = 1,
         ps_kwargs: dict | None = None,
+        router_kwargs: dict | None = None,
     ):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="vearch_tpu_")
         self.master = MasterServer()
@@ -31,6 +32,9 @@ class StandaloneCluster:
         # tighten observability knobs (drift slack, sample interval)
         # without reaching into started servers
         self.ps_kwargs = dict(ps_kwargs or {})
+        # extra RouterServer ctor args — tail-latency tests tune the
+        # hedge delay clamps and flip replica_read the same way
+        self.router_kwargs = dict(router_kwargs or {})
 
     def start(self) -> "StandaloneCluster":
         self.master.start()
@@ -42,7 +46,8 @@ class StandaloneCluster:
             )
             ps.start()
             self.ps_nodes.append(ps)
-        self.router = RouterServer(master_addr=self.master.addr)
+        self.router = RouterServer(master_addr=self.master.addr,
+                                   **self.router_kwargs)
         self.router.start()
         return self
 

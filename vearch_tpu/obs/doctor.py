@@ -186,6 +186,27 @@ def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
         "detail": f"master rollup is {status!r}",
     })
 
+    # sustained overload shedding: a few sheds are admission control
+    # doing its job; a shed RATE above 10% of admitted traffic means
+    # the cluster is turning clients away faster than it serves them —
+    # capacity or limit tuning needed, not backoff
+    shedding = []
+    for srv in report.get("servers", []):
+        adm = (srv.get("stats") or {}).get("admission") or {}
+        shed = int(adm.get("shed_total") or 0)
+        admitted = int(adm.get("admitted_total") or 0)
+        if shed and shed > 0.10 * max(1, admitted):
+            shedding.append(
+                f"node {srv.get('node_id')}: {shed} shed vs "
+                f"{admitted} admitted "
+                f"(queue_limit={adm.get('queue_limit')})"
+            )
+    checks.append({
+        "name": "shed_rate", "ok": not shedding,
+        "detail": ("; ".join(shedding) if shedding
+                   else "admission shed rate within bounds"),
+    })
+
     try:
         ok, detail = _check_obs_docs()
     except Exception as e:

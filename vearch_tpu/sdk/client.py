@@ -71,6 +71,34 @@ class VearchClient:
 
     # -- documents -----------------------------------------------------------
 
+    # overload backoff for the document verbs: a 429 shed from admission
+    # control carries the server's Retry-After hint; honor it with
+    # capped, jittered sleeps and a bounded retry count so a saturated
+    # cluster sees polite clients, not a retry storm
+    max_retries_429 = 3
+    backoff_cap_s = 3.0
+
+    def _doc_call(self, method: str, path: str, body: dict | None = None):
+        """rpc.call with 429 backoff. Only 429 retries here: terminal
+        kills (499 request_killed) and every other error propagate
+        immediately — the kill exists to shed that exact work, and
+        failover retries already live in the router."""
+        import random
+        import time
+
+        attempt = 0
+        while True:
+            try:
+                return rpc.call(self.addr, method, path, body)
+            except rpc.RpcError as e:
+                if e.code != 429 or attempt >= self.max_retries_429:
+                    raise
+                attempt += 1
+                base = (float(e.retry_after) if e.retry_after
+                        else 0.1 * attempt)
+                time.sleep(min(self.backoff_cap_s,
+                               base * random.uniform(0.5, 1.5)))
+
     def upsert(self, db_name: str, space_name: str, documents: list[dict],
                profile: bool = False) -> dict:
         """Upsert documents. With ``profile=True`` the response carries a
@@ -88,7 +116,7 @@ class VearchClient:
         }
         if profile:
             body["profile"] = True
-        return rpc.call(self.addr, "POST", "/document/upsert", body)
+        return self._doc_call("POST", "/document/upsert", body)
 
     def search(
         self,
@@ -100,7 +128,10 @@ class VearchClient:
         fields: list[str] | None = None,
         index_params: dict | None = None,
         ranker: dict | None = None,
-        load_balance: str = "leader",
+        # None defers to the router's configured read routing (leader,
+        # or least-loaded replica when replica_read is on); an explicit
+        # mode always wins
+        load_balance: str | None = None,
         columnar: bool = False,
         sort: Any = None,
         page_size: int | None = None,
@@ -132,8 +163,9 @@ class VearchClient:
         body = {
             "db_name": db_name, "space_name": space_name,
             "vectors": vectors, "limit": limit,
-            "load_balance": load_balance,
         }
+        if load_balance:
+            body["load_balance"] = load_balance
         if filters:
             body["filters"] = filters
         if fields is not None:
@@ -157,13 +189,13 @@ class VearchClient:
             body["cache"] = False
         if profile:
             body["profile"] = True
-            return rpc.call(self.addr, "POST", "/document/search", body)
+            return self._doc_call("POST", "/document/search", body)
         if columnar and fields == []:
             # fields-free throughput mode: scores ride as ONE binary f32
             # buffer instead of b*k JSON dicts; reshaped here so the
             # return type is identical
             body["columnar"] = True
-            out = rpc.call(self.addr, "POST", "/document/search", body)
+            out = self._doc_call("POST", "/document/search", body)
             if out.get("columnar"):
                 flat = np.asarray(out["scores"]).tolist()
                 res, pos = [], 0
@@ -175,7 +207,7 @@ class VearchClient:
                     pos += len(ks)
                 return res
             return out["documents"]
-        return rpc.call(self.addr, "POST", "/document/search", body)["documents"]
+        return self._doc_call("POST", "/document/search", body)["documents"]
 
     def query(
         self,
@@ -200,7 +232,7 @@ class VearchClient:
             body["fields"] = fields
         if sort is not None:
             body["sort"] = sort
-        return rpc.call(self.addr, "POST", "/document/query", body)["documents"]
+        return self._doc_call("POST", "/document/query", body)["documents"]
 
     def delete(
         self,
@@ -217,7 +249,7 @@ class VearchClient:
             body["filters"] = filters
         if limit is not None:
             body["limit"] = limit
-        return rpc.call(self.addr, "POST", "/document/delete", body)["total"]
+        return self._doc_call("POST", "/document/delete", body)["total"]
 
     def flush(self, db_name: str, space_name: str) -> dict:
         return rpc.call(self.addr, "POST", "/index/flush",
