@@ -345,3 +345,71 @@ class TestEngineDiskTier:
         assert eng2.vector_stores["v"].count == 500
         res = eng2.search(SearchRequest(vectors={"v": vecs[3:4]}, k=1))
         assert res[0].items[0].key == "d3"
+
+
+class TestDiskANNCrashRecovery:
+    def test_reopen_after_torn_capacity_growth(self, tmp_path):
+        """A crash between the three scan-tier truncates of a capacity
+        grow (_ensure_capacity) leaves the files at different row
+        capacities — the grown ones hold garbage past the durable
+        count. Reopen must map the minimum capacity (_map_files) and
+        serve every durable row instead of bricking the partition."""
+        base, queries = _data(n=5000)
+        store, idx = _build(tmp_path, base)
+        state = idx.dump_state()
+        store.flush_disk()
+        d = base.shape[1]
+        idx.close()
+
+        # simulate the torn crash: approx8 got its growth truncate to
+        # 10000 rows, then the process died before meta2/assign grew
+        a8 = os.path.join(idx.directory, "approx8.i8")
+        with open(a8, "r+b") as f:
+            f.truncate(10000 * d)
+
+        store2 = DiskRawVectorStore(d, str(tmp_path / "store"))
+        p = IndexParams(
+            index_type="DISKANN",
+            params={"ncentroids": 64, "nprobe": 16, "cache_mb": 64,
+                    "index_dir": idx.directory},
+        )
+        idx2 = create_index(p, store2)
+        idx2.load_state(state)
+        assert idx2.indexed_count == 5000
+        # the mapping took the min capacity, not the torn 10000
+        assert idx2._a8.shape[0] == idx2._m2.shape[0]
+        gt = _gt(base, queries)
+        _, ids = idx2.search(queries, 10, None)
+        assert _recall(ids, gt) >= 0.9
+        idx2.close()
+
+    def test_load_state_reabsorbs_tail_past_durable_count(self, tmp_path):
+        """Rows appended after the last dump are not in the persisted
+        assignment column; load_state must re-absorb them from the raw
+        store so the reopened index serves the full table."""
+        base, queries = _data(n=4000)
+        store, idx = _build(tmp_path, base)
+        state = idx.dump_state()  # durable count: 4000
+        # post-dump appends: the tail the dump never saw
+        tail = queries[:8] + 0.001
+        store.add(tail)
+        idx.absorb(store.count)
+        store.flush_disk()
+        idx.close()
+
+        store2 = DiskRawVectorStore(base.shape[1], str(tmp_path / "store"))
+        assert store2.count == 4008
+        p = IndexParams(
+            index_type="DISKANN",
+            params={"ncentroids": 64, "nprobe": 16, "cache_mb": 64,
+                    "index_dir": idx.directory},
+        )
+        idx2 = create_index(p, store2)
+        idx2.load_state(state)
+        assert idx2.indexed_count == 4008
+        # each tail row is its own query's top-1
+        _, ids = idx2.search(queries[:8], 3, None)
+        np.testing.assert_array_equal(
+            ids[:, 0], np.arange(4000, 4008)
+        )
+        idx2.close()

@@ -625,3 +625,149 @@ def test_roofline_math_and_chip_table():
     assert 1.5e6 < q < 1.6e6
     # roofline scales down with N
     assert perf_model.roofline_qps(2_000_000, 128, 394.7e12) < q
+
+
+# -- gate 5: bytes over PCIe (tiered storage, PERF.md Tier 6) ----------------
+#
+# Disk-tier searches page bucket slabs HBM<-RAM<-NVMe; the PCIe ledger
+# (perf_model.note_h2d_bytes / h2d_bytes_total) records every upload.
+# The gates: cold misses move EXACTLY the modeled slab bytes, a warmed
+# hot working set launches ZERO H2D bytes and ZERO new compiled
+# programs, a repeating probe sequence converges onto pinned or
+# prefetch-confirmed slabs, and the tiering machinery never changes
+# results (bit-identical with prefetch on or off).
+
+
+def _build_disk(tmp_path, name, n=8000, nlist=64, **params):
+    from vearch_tpu.engine.disk_vector import DiskRawVectorStore
+    from vearch_tpu.index.registry import create_index
+
+    # uniform vectors -> near-balanced buckets, so the slab cap (and
+    # with it the slot count under a 1 MB budget) is deterministic-ish;
+    # recall quality is test_disk_index.py's business, not this file's
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal((n, D)).astype(np.float32)
+    store = DiskRawVectorStore(D, str(tmp_path / name))
+    store.add(base)
+    p = IndexParams(
+        index_type="DISKANN",
+        params={"ncentroids": nlist, "nprobe": 8, **params},
+    )
+    idx = create_index(p, store)
+    idx.train(base)
+    idx.absorb(store.count)
+    return base, idx
+
+
+def test_tier_cold_misses_move_exactly_modeled_bytes(tmp_path):
+    base, idx = _build_disk(tmp_path, "cold", cache_mb=1, ram_mb=8,
+                            prefetch=False)
+    try:
+        q = base[:8]
+        b0 = perf_model.h2d_bytes_total()
+        idx.search(q, 10, None)
+        cache = idx._cache
+        st = cache.stats()
+        assert st["misses"] > 0
+        assert perf_model.h2d_bytes_total() - b0 == (
+            perf_model.tier_h2d_bytes(st["misses"], cache.cap, D)
+        ), "cold-path H2D must match the slab model byte-for-byte"
+        assert st["h2d_bytes"] == perf_model.h2d_bytes_total() - b0
+    finally:
+        idx.close()
+
+
+def test_tier_warmed_hot_set_zero_h2d_zero_retrace(tmp_path):
+    """THE steady-state gate: once the hot working set is resident and
+    pinned, a repeat search launches zero H2D bytes and zero new
+    compiled programs — the scan runs entirely from HBM."""
+    base, idx = _build_disk(tmp_path, "warm", cache_mb=1, ram_mb=8)
+    try:
+        q = base[:8]
+        for _ in range(12):  # warm + let pins form
+            idx.search(q, 10, None)
+        idx._prefetcher.drain()
+        b0 = perf_model.h2d_bytes_total()
+        c0 = perf_model.total_compiled_programs()
+        s0, i0 = idx.search(q, 10, None)
+        idx._prefetcher.drain()
+        assert perf_model.h2d_bytes_total() - b0 == 0, (
+            "warmed hot-path search moved bytes over PCIe"
+        )
+        assert perf_model.total_compiled_programs() - c0 == 0, (
+            "warmed hot-path search compiled a new program"
+        )
+        st = idx._cache.stats()
+        assert st["pinned"] > 0  # the hot buckets actually pinned
+        # and the warmed path returns exactly the cold-path results
+        s1, i1 = idx.search(q, 10, None)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(s0, s1)
+    finally:
+        idx.close()
+
+
+def test_tier_repeating_sequence_converges_on_pins_and_prefetch(tmp_path):
+    """A repeating probe sequence must converge to >=90% of lookups
+    landing on pinned or prefetch-confirmed slabs (the acceptance
+    floor): the predictor learns the alternation and the pin set
+    absorbs the stable half."""
+    base, idx = _build_disk(tmp_path, "conv", n=20000, nlist=64,
+                            cache_mb=1, ram_mb=32)
+    try:
+        qa, qb = base[:4], base[10000:10004]
+        for _ in range(30):
+            idx.search(qa, 10, None)
+            idx._prefetcher.drain()
+            idx.search(qb, 10, None)
+            idx._prefetcher.drain()
+        st = idx._cache.stats()
+        lookups = st["hits"] + st["misses"]
+        served = st["pin_hits"] + st["prefetch_hits"]
+        assert lookups > 0
+        assert served / lookups >= 0.9, (
+            f"pin+prefetch hit share {served}/{lookups} below 90%"
+        )
+        pf = idx._prefetcher.stats()
+        assert pf["errors"] == 0
+    finally:
+        idx.close()
+
+
+def test_tier_prefetch_is_bit_identical(tmp_path):
+    base, on = _build_disk(tmp_path, "on", prefetch=True, cache_mb=1)
+    _, off = _build_disk(tmp_path, "off", prefetch=False, cache_mb=1)
+    try:
+        q = base[:16]
+        for _ in range(3):
+            s_on, i_on = on.search(q, 10, None)
+            on._prefetcher.drain()
+            s_off, i_off = off.search(q, 10, None)
+            np.testing.assert_array_equal(i_on, i_off)
+            np.testing.assert_array_equal(s_on, s_off)
+    finally:
+        on.close()
+        off.close()
+
+
+def test_tier_multipass_matches_single_pass(tmp_path):
+    """When the probe set exceeds the HBM slots the search degrades to
+    several fixed-shape passes — same ids, same scores, no ValueError
+    (the graceful-degradation satellite)."""
+    base, small = _build_disk(tmp_path, "mp_small", n=20000, nlist=256,
+                              cache_mb=1, prefetch=False)
+    _, big = _build_disk(tmp_path, "mp_big", n=20000, nlist=256,
+                         cache_mb=512, prefetch=False)
+    try:
+        q = base[:8]
+        p = {"nprobe": 256}
+        groups = small._ensure_cache().plan_passes(
+            np.arange(256).reshape(1, -1))
+        assert len(groups) > 1  # the probe set genuinely overflows
+        s_m, i_m = small.search(q, 10, None, p)
+        s_1, i_1 = big.search(q, 10, None, p)
+        np.testing.assert_array_equal(i_m, i_1)
+        np.testing.assert_allclose(s_m, s_1, rtol=1e-5, atol=1e-5)
+    finally:
+        small.close()
+        big.close()

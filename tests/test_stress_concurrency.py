@@ -314,3 +314,79 @@ def test_concurrent_split_under_lockcheck(tmp_path, rng):
         if master is not None:
             master.stop()
         lockcheck.reset()
+
+
+def test_diskann_absorb_search_under_lockcheck(tmp_path, rng):
+    """The narrowed disk-tier critical section, proven: a realtime
+    writer (store.add + absorb) races searcher threads while the
+    prefetch worker pages slabs in the background. Under
+    VEARCH_LOCKCHECK every tiering lock (absorb, hbm_cache, ram tier,
+    prefetch) is a named DebugLock — the run must leave a non-empty
+    acquisition graph with zero violations, i.e. the absorb lock never
+    nests with the cache locks in an invertible order."""
+    from vearch_tpu.engine.disk_vector import DiskRawVectorStore
+    from vearch_tpu.engine.types import IndexParams
+    from vearch_tpu.index.registry import create_index
+    from vearch_tpu.tools import lockcheck
+
+    lockcheck.reset()
+    lockcheck.enable()  # BEFORE construction: locks are minted at init
+    idx = None
+    try:
+        base = rng.standard_normal((6000, D)).astype(np.float32)
+        store = DiskRawVectorStore(D, str(tmp_path / "dstress"))
+        store.add(base[:4000])
+        p = IndexParams(
+            index_type="DISKANN",
+            params={"ncentroids": 16, "nprobe": 4, "cache_mb": 1,
+                    "ram_mb": 8},
+        )
+        idx = create_index(p, store)
+        idx.train(base[:4000])
+        idx.absorb(store.count)
+
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def writer():
+            try:
+                for lo in range(4000, 6000, 200):
+                    store.add(base[lo:lo + 200])
+                    idx.absorb(store.count)
+            except Exception as e:
+                errors.append(e)
+            finally:
+                stop.set()
+
+        def searcher(tid: int):
+            try:
+                q = base[tid * 8:tid * 8 + 4]
+                while not stop.is_set():
+                    s, ids = idx.search(q, 5, None)
+                    assert ids.shape == (4, 5)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, name="dstress-writer",
+                                    daemon=True)]
+        threads += [
+            threading.Thread(target=searcher, args=(t,),
+                             name=f"dstress-search{t}", daemon=True)
+            for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        idx._prefetcher.drain()
+
+        assert not errors, errors
+        assert idx.indexed_count == 6000
+        # the checker actually saw the tiering locks interact
+        edges = lockcheck.acquisition_edges()
+        assert edges, "lockcheck recorded no lock activity"
+        lockcheck.check()  # raises listing any inversion / guarded write
+    finally:
+        if idx is not None:
+            idx.close()
+        lockcheck.reset()

@@ -1,0 +1,452 @@
+"""Unit tests for the tiered storage engine (vearch_tpu/tiering/ +
+index/hbm_cache.py): RAM-tier admission/eviction/staleness, the row
+cache, the successor predictor, the async prefetch worker, and the
+HBM cache's pinning / prefetch / multi-pass mechanics.
+
+The end-to-end PCIe-ledger gates (zero warm H2D, exact cold-miss
+bytes, prefetch convergence) live in test_perf_gates.py; the lockcheck
+stress lives in test_stress_concurrency.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.index.hbm_cache import HbmBucketCache
+from vearch_tpu.ops import perf_model
+from vearch_tpu.tiering import (
+    HostRamSlabTier,
+    HostRowCache,
+    PrefetchWorker,
+    SequencePredictor,
+)
+from vearch_tpu.tiering.ram_tier import _FreqLruBytes
+
+
+# -- _FreqLruBytes: the shared policy engine ---------------------------------
+
+
+class TestFreqLru:
+    def test_admission_requires_proven_reuse(self):
+        c = _FreqLruBytes(1 << 20, admit_after=2)
+        assert c.get("a") is None  # freq(a)=1
+        assert not c.offer("a", "va", 100)  # 1 < 2 -> rejected
+        assert c.rejected == 1
+        assert c.get("a") is None  # freq(a)=2
+        assert c.offer("a", "va", 100)
+        assert c.admitted == 1
+        assert c.get("a") == "va"
+        assert c.hits == 1
+
+    def test_byte_budget_evicts_lru(self):
+        c = _FreqLruBytes(250, admit_after=1)
+        for k in ("a", "b"):
+            c.get(k)
+            assert c.offer(k, k.upper(), 100)
+        c.get("a")  # a is now MRU
+        c.get("z")
+        assert c.offer("z", "Z", 100)  # 300 > 250 -> evict LRU (b)
+        assert c.evictions == 1
+        assert c.get("b") is None
+        assert c.get("a") == "A"
+        assert c.resident_bytes <= 250
+
+    def test_oversized_value_rejected(self):
+        c = _FreqLruBytes(100, admit_after=1)
+        c.get("big")
+        assert not c.offer("big", "x", 101)
+        assert len(c) == 0
+
+    def test_decay_halves_old_frequency(self):
+        c = _FreqLruBytes(1 << 20, admit_after=2, decay_every=4)
+        c.get("old")
+        c.get("old")  # freq(old)=2: would admit now
+        for i in range(8):  # two epochs pass -> eff(old) = 2 * 0.25
+            c.get(f"noise{i}")
+        assert not c.offer("old", "x", 10)  # decayed below admit_after
+
+    def test_clear_resets_residency(self):
+        c = _FreqLruBytes(1 << 20, admit_after=1)
+        c.get("a")
+        c.offer("a", "x", 64)
+        c.clear()
+        assert len(c) == 0
+        assert c.resident_bytes == 0
+        st = c.stats()
+        assert st["entries"] == 0 and st["admitted"] == 1
+
+
+class TestHostRamSlabTier:
+    def _slab(self, n=4, d=8, fill=1):
+        return (
+            np.full((n, d), fill, np.int8),
+            np.ones(n, np.float32),
+            np.ones(n, np.float32),
+            np.arange(n, dtype=np.int32),
+        )
+
+    def test_gen_match_hits_after_admission(self):
+        tier = HostRamSlabTier(1 << 20, admit_after=1)
+        loads = []
+        def loader():
+            loads.append(1)
+            return self._slab()
+        tier.get(7, 0, loader)
+        tier.get(7, 0, loader)
+        assert len(loads) == 1  # second get served from RAM
+        assert tier.stats()["hits"] == 1
+
+    def test_stale_generation_is_a_miss(self):
+        tier = HostRamSlabTier(1 << 20, admit_after=1)
+        tier.get(7, 0, lambda: self._slab(fill=1))
+        out = tier.get(7, 1, lambda: self._slab(fill=9))  # gen bumped
+        assert out[0][0, 0] == 9  # reloaded, not the stale copy
+        st = tier.stats()
+        assert st["hits"] == 0  # the stale lookup was reclassified
+        assert st["misses"] == 2
+
+    def test_admission_threshold_respected(self):
+        tier = HostRamSlabTier(1 << 20, admit_after=2)
+        loads = []
+        def loader():
+            loads.append(1)
+            return self._slab()
+        tier.get(3, 0, loader)  # freq=1: loaded, NOT admitted
+        tier.get(3, 0, loader)  # freq=2: loaded again, admitted now
+        tier.get(3, 0, loader)  # RAM hit
+        assert len(loads) == 2
+
+
+class TestHostRowCache:
+    def test_partial_hit_gathers_only_misses(self):
+        rows = np.arange(80, dtype=np.float32).reshape(10, 8)
+        cache = HostRowCache(8, 1 << 20, admit_after=1)
+        calls = []
+        def loader(ids):
+            calls.append(np.array(ids))
+            return rows[ids]
+        out = cache.get_rows(np.array([1, 3]), loader)
+        np.testing.assert_array_equal(out, rows[[1, 3]])
+        out = cache.get_rows(np.array([1, 3, 5]), loader)
+        np.testing.assert_array_equal(out, rows[[1, 3, 5]])
+        assert len(calls) == 2
+        np.testing.assert_array_equal(calls[1], [5])  # only the miss
+
+    def test_clear_forces_reload(self):
+        rows = np.ones((4, 8), np.float32)
+        cache = HostRowCache(8, 1 << 20, admit_after=1)
+        calls = []
+        def loader(ids):
+            calls.append(1)
+            return rows[ids]
+        cache.get_rows(np.array([0]), loader)
+        cache.get_rows(np.array([0]), loader)
+        assert len(calls) == 1
+        cache.clear()
+        cache.get_rows(np.array([0]), loader)
+        assert len(calls) == 2
+
+
+# -- prefetch machinery ------------------------------------------------------
+
+
+class TestSequencePredictor:
+    def test_learns_first_order_successor(self):
+        p = SequencePredictor()
+        assert p.observe("a") is None
+        assert p.observe("b") is None  # records a -> b
+        assert p.observe("a") == "b"
+        assert p.observe("b") == "a"  # and learned b -> a meanwhile
+
+    def test_self_transition_ignored(self):
+        p = SequencePredictor()
+        p.observe("a")
+        assert p.observe("a") is None  # a -> a is not a transition
+        assert len(p) == 0
+
+    def test_capacity_bound(self):
+        p = SequencePredictor(capacity=4)
+        for i in range(20):
+            p.observe(i)
+        assert len(p) <= 4
+
+
+class TestPrefetchWorker:
+    def test_runs_jobs_and_drains(self):
+        done = []
+        w = PrefetchWorker(done.append)
+        try:
+            for i in range(5):
+                w.submit(i)
+                assert w.drain(timeout=5.0)
+            assert sorted(done) == list(range(5))
+            st = w.stats()
+            assert st["submitted"] == 5 and st["completed"] == 5
+            assert st["errors"] == 0
+        finally:
+            w.close()
+
+    def test_drops_stale_jobs_when_saturated(self):
+        gate = threading.Event()
+        ran = []
+        def slow(job):
+            gate.wait(timeout=10.0)
+            ran.append(job)
+        w = PrefetchWorker(slow, depth=1)
+        try:
+            w.submit("first")
+            time.sleep(0.05)  # let the worker pick it up
+            w.submit("stale")
+            w.submit("fresh")  # queue full -> "stale" dropped
+            gate.set()
+            assert w.drain(timeout=5.0)
+            assert w.dropped >= 1
+            assert "fresh" in ran
+            assert "stale" not in ran
+        finally:
+            w.close()
+
+    def test_errors_counted_not_propagated(self):
+        def boom(job):
+            raise RuntimeError("nope")
+        w = PrefetchWorker(boom)
+        try:
+            w.submit(1)
+            assert w.drain(timeout=5.0)
+            assert w.errors == 1
+            w.submit(2)  # worker survived the exception
+            assert w.drain(timeout=5.0)
+            assert w.errors == 2
+        finally:
+            w.close()
+
+    def test_submit_after_close_is_noop(self):
+        w = PrefetchWorker(lambda j: None)
+        w.submit(1)
+        assert w.drain(timeout=5.0)
+        w.close()
+        w.submit(2)
+        assert w.stats()["submitted"] == 1
+
+
+# -- HbmBucketCache: pinning, prefetch, multi-pass ---------------------------
+
+
+def _mk_fetch(d=8, nb=4):
+    def fetch(b):
+        return (
+            np.full((nb, d), b % 127, np.int8),
+            np.ones(nb, np.float32),
+            np.ones(nb, np.float32),
+            (np.arange(nb) + b * nb).astype(np.int32),
+        )
+    return fetch
+
+
+class TestHbmBucketCache:
+    def test_slab_bytes_matches_perf_model(self):
+        c = HbmBucketCache(8, slots=4, cap=16)
+        assert c.slab_bytes == perf_model.slab_bytes(16, 8)
+        assert c.hbm_bytes == 4 * c.slab_bytes
+
+    def test_resolve_counts_and_ledger(self):
+        c = HbmBucketCache(8, slots=4, cap=16, pin_slots=0)
+        fetch = _mk_fetch()
+        b0 = perf_model.h2d_bytes_total()
+        c.resolve(np.array([[0, 1], [1, 2]]), {}, fetch)
+        # accounting is per unique bucket: {0, 1, 2} all cold
+        assert c.misses == 3 and c.hits == 0
+        moved = perf_model.h2d_bytes_total() - b0
+        assert moved == perf_model.tier_h2d_bytes(3, 16, 8)
+        assert c.h2d_bytes == moved
+        c.resolve(np.array([[0, 1], [1, 2]]), {}, fetch)
+        assert c.misses == 3 and c.hits == 3  # all resident now
+        assert perf_model.h2d_bytes_total() - b0 == moved
+
+    def test_probe_set_over_slots_raises_on_resolve(self):
+        c = HbmBucketCache(8, slots=2, cap=16)
+        with pytest.raises(ValueError, match="cache_mb"):
+            c.resolve(np.array([[0, 1, 2]]), {}, _mk_fetch())
+
+    def test_plan_passes_splits_and_acquire_restrict_masks(self):
+        c = HbmBucketCache(8, slots=2, cap=16, pin_slots=0)
+        probes = np.array([[0, 1, 2, 3]])
+        groups = c.plan_passes(probes)
+        assert len(groups) == 2
+        assert sorted(b for g in groups for b in g) == [0, 1, 2, 3]
+        fetch = _mk_fetch()
+        slots0, _ = c.acquire(probes, {}, fetch, restrict=groups[0])
+        # deferred probes ride as slot -1, resolved ones are valid slots
+        in0 = set(groups[0])
+        for b, s in zip(probes[0], slots0[0]):
+            assert (s >= 0) == (int(b) in in0)
+        slots1, _ = c.acquire(probes, {}, fetch, restrict=groups[1])
+        in1 = set(groups[1])
+        for b, s in zip(probes[0], slots1[0]):
+            assert (s >= 0) == (int(b) in in1)
+
+    def test_pins_form_and_pin_hits_count(self):
+        c = HbmBucketCache(8, slots=4, cap=16, pin_slots=2)
+        fetch = _mk_fetch()
+        for _ in range(3):  # buckets 0,1 prove reuse -> pinned
+            c.resolve(np.array([[0, 1]]), {}, fetch)
+        assert c.stats()["pinned"] == 2
+        ph = c.pin_hits
+        c.resolve(np.array([[0, 1]]), {}, fetch)
+        assert c.pin_hits == ph + 2
+
+    def test_pinned_buckets_survive_eviction_pressure(self):
+        c = HbmBucketCache(8, slots=3, cap=16, pin_slots=1)
+        fetch = _mk_fetch()
+        for _ in range(3):
+            c.resolve(np.array([[0]]), {}, fetch)  # bucket 0 pins
+        assert c.stats()["pinned"] == 1
+        m0 = c.misses
+        for b in (1, 2, 3, 4, 5):  # churn the evictable slots
+            c.resolve(np.array([[b]]), {}, fetch)
+        c.resolve(np.array([[0]]), {}, fetch)  # still resident
+        assert c.misses == m0 + 5
+
+    def test_prefetch_uploads_and_marks_hits(self):
+        c = HbmBucketCache(8, slots=4, cap=16, pin_slots=0)
+        fetch = _mk_fetch()
+        n = c.prefetch([0, 1], {}, fetch)
+        assert n == 2 and c.prefetched == 2
+        assert c.misses == 0  # prefetch never touches demand counters
+        c.resolve(np.array([[0, 1]]), {}, fetch)
+        assert c.hits == 2 and c.prefetch_hits == 2 and c.misses == 0
+
+    def test_prefetch_marks_already_resident_buckets(self):
+        c = HbmBucketCache(8, slots=4, cap=16, pin_slots=0)
+        fetch = _mk_fetch()
+        c.resolve(np.array([[0]]), {}, fetch)  # demand upload
+        assert c.prefetch([0], {}, fetch) == 0  # resident: no upload
+        c.resolve(np.array([[0]]), {}, fetch)
+        assert c.prefetch_hits == 1  # residency was prefetch-confirmed
+
+    def test_prefetch_never_evicts_pins_or_last_resolved(self):
+        c = HbmBucketCache(8, slots=2, cap=16, pin_slots=0)
+        fetch = _mk_fetch()
+        c.resolve(np.array([[0, 1]]), {}, fetch)  # both slots busy
+        assert c.prefetch([2], {}, fetch) == 0  # nothing evictable
+        c.resolve(np.array([[0, 1]]), {}, fetch)
+        assert c.misses == 2  # 0 and 1 were never evicted
+
+    def test_stale_generation_reuploads_in_place(self):
+        c = HbmBucketCache(8, slots=2, cap=16, pin_slots=0)
+        fetch = _mk_fetch()
+        c.resolve(np.array([[0]]), {0: 0}, fetch)
+        ev = c.evictions
+        c.resolve(np.array([[0]]), {0: 1}, fetch)  # gen bump -> miss
+        assert c.misses == 2
+        assert c.evictions == ev  # same slot reused, no eviction
+
+    def test_seed_counters_carries_lifetime_totals(self):
+        c = HbmBucketCache(8, slots=2, cap=16)
+        c.seed_counters({"hits": 10, "misses": 4, "h2d_bytes": 512})
+        st = c.stats()
+        assert st["hits"] == 10 and st["misses"] == 4
+        assert st["h2d_bytes"] == 512
+
+    def test_invalidate_resets_residency(self):
+        c = HbmBucketCache(8, slots=2, cap=16)
+        fetch = _mk_fetch()
+        c.resolve(np.array([[0, 1]]), {}, fetch)
+        c.invalidate()
+        st = c.stats()
+        assert st["resident"] == 0 and st["hits"] == 0
+        c.resolve(np.array([[0]]), {}, fetch)
+        assert c.misses == 1  # cold again
+
+
+# -- PS aggregation + doctor check -------------------------------------------
+
+
+def test_ps_tier_snapshot_label_sets_are_fixed():
+    """Callback metrics must return the full zero-filled label set every
+    scrape, with or without tiering traffic (series-ceiling discipline)."""
+    from vearch_tpu.cluster.ps import PSServer
+
+    class _Eng:
+        def tiering_info(self):
+            return {"fields": {"v": {
+                "hbm": {"hits": 3, "misses": 1, "evictions": 0,
+                        "pin_hits": 2, "prefetch_hits": 1, "prefetched": 4,
+                        "resident_bytes": 1024},
+                "ram": {"hits": 5, "misses": 2, "evictions": 1,
+                        "admitted": 2, "rejected": 1,
+                        "resident_bytes": 2048},
+                "row_cache": {"hits": 7, "misses": 3, "evictions": 0,
+                              "admitted": 3, "rejected": 0,
+                              "resident_bytes": 4096},
+                "prefetch": {"submitted": 6, "completed": 5,
+                             "dropped": 1, "errors": 0},
+            }}}
+
+    class _Empty:
+        def tiering_info(self):
+            return None
+
+    ps = object.__new__(PSServer)
+    ps.engines = {"p0": _Eng(), "p1": _Empty()}
+    events, resident = ps._tier_snapshot()
+    assert set(events) == set(PSServer._TIER_EVENT_KEYS)
+    assert events[("hbm", "hit")] == 3
+    assert events[("hbm", "pin_hit")] == 2
+    assert events[("ram", "admitted")] == 2
+    assert events[("row", "hit")] == 7
+    assert events[("prefetch", "dropped")] == 1
+    assert resident[("hbm",)] == 1024
+    assert resident[("row",)] == 4096
+    # empty engines: same keys, zero values
+    ps.engines = {"p1": _Empty()}
+    events2, resident2 = ps._tier_snapshot()
+    assert set(events2) == set(events)
+    assert all(v == 0 for v in events2.values())
+    assert all(v == 0 for v in resident2.values())
+
+
+class TestDoctorPrefetchCheck:
+    def _report(self, hbm):
+        return {
+            "servers": [{
+                "addr": "ps0",
+                "stats": {"partitions": {"p0": {"tiering": {"fields": {
+                    "v": {
+                        "hbm": hbm,
+                        "ram": {},
+                        "prefetch": {"enabled": True, "submitted": 10,
+                                     "completed": 10, "dropped": 0,
+                                     "errors": 0},
+                    },
+                }}}}},
+            }],
+        }
+
+    def _run(self, report):
+        from vearch_tpu.obs import doctor
+
+        checks = doctor.run_checks(report)
+        return {c["name"]: c for c in checks}
+
+    def test_flags_ineffective_prefetch(self):
+        hbm = {"hits": 600, "misses": 400, "pin_hits": 50,
+               "prefetch_hits": 50}
+        out = self._run(self._report(hbm))
+        c = out["prefetch_effectiveness"]
+        assert not c["ok"]
+
+    def test_passes_when_hot_path_lands_on_pins(self):
+        hbm = {"hits": 950, "misses": 50, "pin_hits": 700,
+               "prefetch_hits": 200}
+        out = self._run(self._report(hbm))
+        assert out["prefetch_effectiveness"]["ok"]
+
+    def test_skips_below_traffic_floor(self):
+        hbm = {"hits": 10, "misses": 5, "pin_hits": 0,
+               "prefetch_hits": 0}
+        out = self._run(self._report(hbm))
+        c = out["prefetch_effectiveness"]
+        assert c["ok"]  # not enough lookups to judge

@@ -628,6 +628,26 @@ class PSServer:
                            "across hosted engines",
                            ("event",), _filter_cache_events)
 
+        # tiered storage observability (tiering tentpole): both
+        # callbacks render the FULL fixed (tier, event) label set from
+        # the first scrape, zero-filled — an engine whose disk tier
+        # warms up mid-soak must not mint new series.
+        m.callback_counter("vearch_ps_tier_events_total",
+                           "tiered-storage events summed across hosted "
+                           "engines: HBM slab cache "
+                           "(hit/miss/eviction/pin_hit/prefetch_hit/"
+                           "prefetched), host-RAM slab tier and rerank "
+                           "row cache (hit/miss/eviction/admitted/"
+                           "rejected), prefetch worker "
+                           "(submitted/completed/dropped/error)",
+                           ("tier", "event"),
+                           lambda: self._tier_snapshot()[0])
+        m.callback_gauge("vearch_ps_tier_resident_bytes",
+                         "resident bytes per storage tier summed "
+                         "across hosted engines",
+                         ("tier",),
+                         lambda: self._tier_snapshot()[1])
+
         # runtime truth layer (obs tentpole). Device labels are bounded
         # by the local device count, op/q labels by fixed tuples — all
         # rendered from the first scrape, so the cardinality soak sees
@@ -2814,6 +2834,10 @@ class PSServer:
                     "raft": self.raft_nodes[pid].state()
                     if pid in self.raft_nodes else None,
                     "mesh": self._mesh_info_safe(eng),
+                    # tiered storage (HBM slab cache / host-RAM tiers /
+                    # prefetch) — the doctor's prefetch-effectiveness
+                    # check reads these blocks
+                    "tiering": self._tiering_info_safe(eng),
                 }
                 for pid, eng in self.engines.items()
             },
@@ -2825,3 +2849,64 @@ class PSServer:
             return eng.mesh_info()
         except Exception:
             return None
+
+    @staticmethod
+    def _tiering_info_safe(eng) -> dict | None:
+        try:
+            return eng.tiering_info()
+        except Exception:
+            return None
+
+    # fixed (tier, event) label universe for vearch_ps_tier_events_total
+    # — rendered zero-filled every scrape so the cardinality soak sees
+    # no series growth as disk tiers warm up
+    _TIER_EVENT_KEYS = (
+        ("hbm", "hit"), ("hbm", "miss"), ("hbm", "eviction"),
+        ("hbm", "pin_hit"), ("hbm", "prefetch_hit"), ("hbm", "prefetched"),
+        ("ram", "hit"), ("ram", "miss"), ("ram", "eviction"),
+        ("ram", "admitted"), ("ram", "rejected"),
+        ("row", "hit"), ("row", "miss"), ("row", "eviction"),
+        ("row", "admitted"), ("row", "rejected"),
+        ("prefetch", "submitted"), ("prefetch", "completed"),
+        ("prefetch", "dropped"), ("prefetch", "error"),
+    )
+    _CACHE_EVENT_MAP = (
+        ("hits", "hit"), ("misses", "miss"), ("evictions", "eviction"),
+        ("admitted", "admitted"), ("rejected", "rejected"),
+    )
+
+    def _tier_snapshot(self) -> tuple[dict, dict]:
+        """(events, resident-bytes) label maps for the tier metrics
+        callbacks, summed across hosted engines."""
+        events = {k: 0.0 for k in self._TIER_EVENT_KEYS}
+        resident = {("hbm",): 0.0, ("ram",): 0.0, ("row",): 0.0}
+
+        def bump(tier: str, stats: dict, mapping) -> None:
+            for src, dst in mapping:
+                events[(tier, dst)] += float(stats.get(src, 0))
+
+        for eng in list(self.engines.values()):
+            info = self._tiering_info_safe(eng)
+            if not info:
+                continue
+            for f in (info.get("fields") or {}).values():
+                hbm = f.get("hbm") or {}
+                bump("hbm", hbm, (
+                    ("hits", "hit"), ("misses", "miss"),
+                    ("evictions", "eviction"), ("pin_hits", "pin_hit"),
+                    ("prefetch_hits", "prefetch_hit"),
+                    ("prefetched", "prefetched"),
+                ))
+                resident[("hbm",)] += float(hbm.get("resident_bytes", 0))
+                ram = f.get("ram") or {}
+                bump("ram", ram, self._CACHE_EVENT_MAP)
+                resident[("ram",)] += float(ram.get("resident_bytes", 0))
+                row = f.get("row_cache") or {}
+                bump("row", row, self._CACHE_EVENT_MAP)
+                resident[("row",)] += float(row.get("resident_bytes", 0))
+                pf = f.get("prefetch") or {}
+                bump("prefetch", pf, (
+                    ("submitted", "submitted"), ("completed", "completed"),
+                    ("dropped", "dropped"), ("errors", "error"),
+                ))
+        return events, resident

@@ -19,6 +19,12 @@ The full-precision file is the rerank/training tier; the scan tier is
 the DISKANN index's int8 mmap + HBM bucket cache (index/disk.py). A
 `device_buffer()` call on this store intentionally raises: mirroring a
 beyond-RAM store into HBM is always a bug upstream.
+
+Rerank gathers route through a host-RAM row cache
+(tiering/HostRowCache): hot candidate rows — the ones Zipf query mixes
+re-rank every batch — are served from anonymous RAM instead of
+re-faulting mmap pages, with frequency-based admission so one-shot
+scans can't flush the hot set. `row_cache_mb=0` disables it.
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ import os
 import numpy as np
 
 from vearch_tpu.engine.raw_vector import RawVectorStore
+from vearch_tpu.tiering import HostRowCache
 
 
 class DiskRawVectorStore(RawVectorStore):
@@ -40,6 +47,7 @@ class DiskRawVectorStore(RawVectorStore):
         directory: str,
         init_capacity: int = 4096,
         store_dtype: str = "float32",
+        row_cache_mb: int = 64,
     ):
         # note: base __init__ is NOT called — the host buffer is a memmap
         self.dimension = dimension
@@ -73,6 +81,10 @@ class DiskRawVectorStore(RawVectorStore):
             self._n = int(meta["n"])
             durable_cap = max(durable_cap, self._n)
         self._host = self._map(max(durable_cap, 1))
+        self.row_cache = (
+            HostRowCache(dimension, int(row_cache_mb) << 20)
+            if row_cache_mb else None
+        )
         # device mirror fields kept for interface parity (never populated)
         self._device = None
         self._device_sqnorm = None
@@ -113,9 +125,17 @@ class DiskRawVectorStore(RawVectorStore):
         return self.get_rows(np.asarray([docid]))[0]
 
     def get_rows(self, docids: np.ndarray) -> np.ndarray:
-        """Gather [len(docids), d] f32 rows (rerank path — pages fault in
-        from disk on demand; hot rows ride the OS page cache)."""
-        return np.asarray(self._host[np.asarray(docids, dtype=np.int64)])
+        """Gather [len(docids), d] f32 rows (rerank path). Hot rows come
+        from the host-RAM row cache; misses fault pages in from the mmap
+        (rows are append-only and immutable, so cached copies never go
+        stale — the load paths clear the cache before rewriting)."""
+
+        def _gather(ids: np.ndarray) -> np.ndarray:
+            return np.asarray(self._host[np.asarray(ids, dtype=np.int64)])
+
+        if self.row_cache is None:
+            return _gather(docids).astype(np.float32, copy=False)
+        return self.row_cache.get_rows(docids, _gather)
 
     def device_buffer(self):
         raise RuntimeError(
@@ -168,6 +188,8 @@ class DiskRawVectorStore(RawVectorStore):
         the live count back to the durable barrier in meta.json so a
         live-engine load() is symmetric with RAM-backed stores (table
         and store counts must revert together — docid == row id)."""
+        if self.row_cache is not None:
+            self.row_cache.clear()
         if not os.path.exists(path):
             if os.path.exists(self._meta_path):
                 with open(self._meta_path) as f:
@@ -192,6 +214,8 @@ class DiskRawVectorStore(RawVectorStore):
         carry no vector segments — load() rolls back via meta.json)."""
         if not paths:  # in-place dump: Engine.load uses load() instead
             return
+        if self.row_cache is not None:
+            self.row_cache.clear()
         self._n = 0
         total = 0
         for p in paths:

@@ -212,6 +212,7 @@ class Engine:
                     f.dimension,
                     directory=os.path.join(base, f"disk_{f.name}"),
                     store_dtype=dtype,
+                    row_cache_mb=int(params.get("row_cache_mb", 64)),
                 )
             else:
                 store = RawVectorStore(f.dimension, store_dtype=dtype)
@@ -569,6 +570,13 @@ class Engine:
             if self._microbatcher is not None:
                 self._microbatcher.stop()
                 self._microbatcher = None
+        # outside _write_lock: index close only stops background tier
+        # workers (prefetchers) and must not order under the write path
+        for index in self.indexes.values():
+            try:
+                index.close()
+            except Exception as e:
+                log.warn("index close failed: %s", e)
 
     def apply_config(self, cfg: dict[str, Any]) -> dict[str, Any]:
         """Runtime-mutable engine config (reference: master /config API ->
@@ -1167,11 +1175,19 @@ class Engine:
             [f"mesh.{name}", mono_us(t0), int((t1 - t0) * 1e6)]
             for name, t0, t1 in capture.mesh_phases
         )
+        spans.extend(
+            [f"tier.{name}", mono_us(t0), int((t1 - t0) * 1e6)]
+            for name, t0, t1 in capture.tier_phases
+        )
         trace["_phase_spans"] = spans
         if capture.mesh_phases or any(t.startswith("sharded") for t in tags):
             info = self.mesh_info()
             if info is not None:
                 trace["mesh"] = info
+        if capture.tier_phases:
+            tinfo = self.tiering_info()
+            if tinfo is not None:
+                trace["tiering"] = tinfo
 
     def mesh_info(self) -> dict[str, Any] | None:
         """Aggregate mesh data-plane summary over the engine's vector
@@ -1192,6 +1208,26 @@ class Engine:
             "fields": fields,
         }
         return out
+
+    def tiering_info(self) -> dict[str, Any] | None:
+        """Aggregate tiered-storage summary over the engine's vector
+        fields (surfaced in /ps/stats and profile:true traces); None
+        when no field serves through the storage tiers."""
+        fields: dict[str, Any] = {}
+        for name, index in self.indexes.items():
+            try:
+                info = index.tiering_info()
+            except Exception:
+                info = None
+            row_cache = getattr(self.vector_stores[name], "row_cache", None)
+            if row_cache is not None:
+                info = dict(info or {"kind": "disk_store"})
+                info["row_cache"] = row_cache.stats()
+            if info is not None:
+                fields[name] = info
+        if not fields:
+            return None
+        return {"fields": fields}
 
     def _predicted_scan_bytes(self, name: str) -> int:
         """Perf-model prediction of stage-1 scan HBM read bytes for one

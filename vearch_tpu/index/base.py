@@ -20,13 +20,13 @@ Contract differences from the reference, driven by TPU semantics:
 from __future__ import annotations
 
 import abc
-import threading
 from typing import Any
 
 import numpy as np
 
 from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.engine.types import IndexParams, MetricType
+from vearch_tpu.tools import lockcheck
 
 
 class VectorIndex(abc.ABC):
@@ -42,8 +42,10 @@ class VectorIndex(abc.ABC):
         self.trained = not self.needs_training
         self.indexed_count = 0  # rows absorbed into the index structure
         # serialises concurrent absorb() from search threads / the
-        # background build thread (reference: engine.cc CAS state machine)
-        self._absorb_lock = threading.Lock()
+        # background build thread (reference: engine.cc CAS state machine);
+        # minted via lockcheck so VEARCH_LOCKCHECK=1 stress runs verify
+        # the narrowed search critical sections hold no surprise orders
+        self._absorb_lock = lockcheck.make_lock("index_absorb")
 
     @property
     def input_dim(self) -> int:
@@ -109,6 +111,16 @@ class VectorIndex(abc.ABC):
         """Mesh data-plane placement summary, None when this index is
         not mesh-serving (single device)."""
         return None
+
+    def tiering_info(self) -> dict[str, Any] | None:
+        """Tiered-storage summary (per-tier hit/miss/pin counters,
+        residency bytes — see docs/TIERING.md), None when this index
+        serves entirely from device memory."""
+        return None
+
+    def close(self) -> None:
+        """Release background resources (prefetch workers, mmaps).
+        Idempotent; default is a no-op for in-memory indexes."""
 
     # -- persistence (index-specific state only; raw vectors are dumped by
     #    the engine — reference: index is rebuildable, vectors are durable)

@@ -11,14 +11,21 @@ host RAM and HBM) with MXU-shaped machinery:
            approx8.i8    per-row int8 approximations (scan tier)
            meta2.f32     per-row (scale, ||approx||^2)
            assign.i32    per-row coarse assignment (bucket rebuild)
-    RAM    per-bucket docid lists (~8 B/row), centroids
-    HBM    coarse centroids + an LRU bucket cache (HbmBucketCache)
+    RAM    per-bucket docid lists (~8 B/row), centroids, and a
+           frequency-admitted slab tier (tiering/HostRamSlabTier) so an
+           HBM miss costs a memcpy, not a page-fault walk
+    HBM    coarse centroids (always resident) + a bucket slab cache
+           with hot-bucket pinning (HbmBucketCache)
 
 Search: coarse top-nprobe on device -> resolve probed buckets against
-the HBM cache (misses page slabs in from the mmap) -> int8 bucket scan
-(ops/ivf.py cached_bucket_scan) -> exact rerank of the top candidates
-against host-gathered raw rows. Hot buckets never touch disk again; the
-OS page cache backstops warm ones.
+the HBM cache (misses page slabs RAM->device; RAM misses gather from
+the mmap) -> int8 bucket scan (ops/ivf.py cached_bucket_scan) -> exact
+rerank of the top candidates against host-gathered raw rows. The
+coarse probe result also feeds a successor predictor whose predicted
+next probe set prefetches asynchronously (tiering/prefetch.py), so a
+steady workload's transfers overlap the previous scan and its warmed
+hot path launches zero H2D bytes. Hot buckets never touch disk again;
+the OS page cache backstops warm ones. See docs/TIERING.md.
 
 Divergences from the reference, on purpose:
 - per-row int8 replaces PQ for the scan tier: the scan reads decoded
@@ -33,7 +40,8 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Any
+import time
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +56,12 @@ from vearch_tpu.index.registry import register_index
 from vearch_tpu.ops import ivf as ivf_ops
 from vearch_tpu.ops import kmeans as km
 from vearch_tpu.ops.distance import to_device_mask
+from vearch_tpu.tiering import (
+    HostRamSlabTier,
+    PrefetchWorker,
+    SequencePredictor,
+)
+from vearch_tpu.tools import lockcheck
 
 _ABSORB_CHUNK = 262_144  # rows per device assignment batch
 
@@ -64,10 +78,23 @@ class DiskANNIndex(VectorIndex):
         self.train_sample = int(params.get("training_sample", 262_144))
         self.train_iters = int(params.get("train_iters", 10))
         self.cache_mb = int(params.get("cache_mb", 512))
+        # tiered-storage knobs (docs/TIERING.md): host-RAM slab tier
+        # budget, prefetch on/off, hot-bucket pin share of HBM slots,
+        # RAM-tier admission threshold
+        self.ram_mb = int(params.get("ram_mb", 256))
+        self.prefetch_enabled = bool(params.get("prefetch", True))
+        self._pin_slots_param = params.get("pin_slots")
+        admit_after = int(params.get("admit_after", 2))
         self.centroids: jax.Array | None = None
         self._members: list[list[int]] = []
         self._gens: dict[int, int] = {}
         self._cache: HbmBucketCache | None = None
+        self._ram_tier = HostRamSlabTier(
+            self.ram_mb << 20, admit_after=admit_after
+        )
+        self._predictor = SequencePredictor()
+        self._prefetcher = PrefetchWorker(self._prefetch_job)
+        self._pf_lock = lockcheck.make_lock("diskann_prefetch")
         directory = params.get("index_dir") or getattr(
             store, "directory", None
         )
@@ -198,26 +225,57 @@ class DiskANNIndex(VectorIndex):
         cap = self._slab_cap()
         d = self.store.dimension
         slab_bytes = cap * (d + 12)
-        # cache_mb is a hard HBM budget — never exceeded; if it affords
-        # too few slots for a probe set, resolve() raises the documented
-        # "raise cache_mb" error instead of silently OOMing the device
+        # cache_mb is a hard HBM budget — never exceeded; a probe set
+        # that cannot fit one pass degrades to multiple fixed-shape
+        # passes (plan_passes/acquire) instead of failing the search
         slots = max(1, min(self.nlist, (self.cache_mb << 20) // slab_bytes))
         if (
             self._cache is None
             or self._cache.cap < cap
             or self._cache.slots != slots
         ):
-            self._cache = HbmBucketCache(d, slots, cap)
+            old = self._cache
+            self._cache = HbmBucketCache(
+                d, slots, cap, pin_slots=self._pin_slots_param
+            )
+            if old is not None:
+                # capacity regrow, not a reset: keep operator-facing
+                # lifetime counters continuous across the rebuild
+                self._cache.seed_counters(old.stats())
         return self._cache
 
+    def _make_fetch(
+        self, gens: dict[int, int], n_snap: int
+    ) -> Callable[[int], tuple[np.ndarray, ...]]:
+        """Slab fetch closure for a consistent (gens, indexed_count)
+        snapshot. An HBM miss goes to the host-RAM slab tier first; a
+        RAM miss pays the NVMe mmap gather. Safe to run outside the
+        absorb lock: absorb writes mmap rows BEFORE publishing bucket
+        membership, appended docids only grow past `n_snap` (filtered
+        here and masked by the validity snapshot on device)."""
+
+        def fetch(b: int):
+            def loader():
+                ids = np.asarray(self._members[b], dtype=np.int64)
+                ids = ids[ids < n_snap]
+                a8, m2 = self._a8, self._m2
+                ids = ids[ids < a8.shape[0]]
+                return (
+                    np.asarray(a8[ids]),
+                    np.asarray(m2[ids, 0]),
+                    np.asarray(m2[ids, 1]),
+                    ids.astype(np.int32),
+                )
+
+            return self._ram_tier.get(b, gens.get(b, 0), loader)
+
+        return fetch
+
     def _fetch_bucket(self, b: int):
-        ids = np.asarray(self._members[b], dtype=np.int64)
-        return (
-            np.asarray(self._a8[ids]),
-            np.asarray(self._m2[ids, 0]),
-            np.asarray(self._m2[ids, 1]),
-            ids.astype(np.int32),
-        )
+        """Single-bucket slab fetch at the live snapshot (direct cache
+        pokes in tests; the search path builds fetch closures over a
+        consistent snapshot via _make_fetch)."""
+        return self._make_fetch(dict(self._gens), self.indexed_count)(b)
 
     # -- search --------------------------------------------------------------
 
@@ -241,21 +299,53 @@ class DiskANNIndex(VectorIndex):
             if self.metric is MetricType.COSINE
             else self.metric
         )
+        # narrowed critical section (satellite): the absorb lock only
+        # guards the snapshot — cache shape, generation map, durable row
+        # count. The coarse-probe dispatch, slab resolution and scan all
+        # run outside it, so realtime ingest never stalls behind a
+        # disk-tier search (HbmBucketCache has its own lock; the fetch
+        # closure is snapshot-consistent, see _make_fetch).
         with self._absorb_lock:
             cache = self._ensure_cache()
-            probes = np.asarray(
-                ivf_ops._coarse_probes(
-                    jnp.asarray(q), self.centroids, nprobe
-                )
-            )  # [B, nprobe] host
-            slots = cache.resolve(probes, self._gens, self._fetch_bucket)
-            pool8, pool_sc, pool_sq, pool_id = cache.pools()
+            gens = dict(self._gens)
+            n_indexed = self.indexed_count
+        qd = jnp.asarray(q)
+        probes = np.asarray(
+            ivf_ops._coarse_probes(qd, self.centroids, nprobe)
+        )  # [B, nprobe] host
+        self._schedule_prefetch(probes, gens)
+        fetch = self._make_fetch(gens, n_indexed)
         n_pad = max(self.store.capacity, 1)
-        valid = to_device_mask(valid_mask, self.indexed_count, n_pad)
-        cand_s, cand_i = ivf_ops.cached_bucket_scan(
-            jnp.asarray(q), pool8, pool_sc, pool_sq, pool_id,
-            jnp.asarray(slots), valid, r, metric,
-        )
+        valid = to_device_mask(valid_mask, n_indexed, n_pad)
+        groups = cache.plan_passes(probes)
+        if len(groups) == 1:
+            slots, pools = cache.acquire(probes, gens, fetch)
+            cand_s, cand_i = ivf_ops.cached_bucket_scan(
+                qd, *pools, jnp.asarray(slots), valid, r, metric,
+            )
+        else:
+            # graceful degradation (satellite): probe set exceeds the
+            # evictable HBM slots — scan it in several fixed-shape
+            # passes (deferred probes ride as slot -1, masked in the
+            # kernel) and fold the per-pass top lists. Buckets are
+            # disjoint across passes, so the fold never sees duplicate
+            # docids.
+            parts_s: list[np.ndarray] = []
+            parts_i: list[np.ndarray] = []
+            for group in groups:
+                slots, pools = cache.acquire(
+                    probes, gens, fetch, restrict=group
+                )
+                s_g, i_g = ivf_ops.cached_bucket_scan(
+                    qd, *pools, jnp.asarray(slots), valid, r, metric,
+                )
+                parts_s.append(np.asarray(s_g))
+                parts_i.append(np.asarray(i_g))
+            cat_s = np.concatenate(parts_s, axis=1)
+            cat_i = np.concatenate(parts_i, axis=1)
+            order = np.argsort(-cat_s, axis=1, kind="stable")[:, :r]
+            cand_s = np.take_along_axis(cat_s, order, axis=1)
+            cand_i = np.take_along_axis(cat_i, order, axis=1)
         from vearch_tpu.index._store_paths import rerank_against_store
 
         # rerank tier: raw rows fault in from the mmap'd store (or the
@@ -272,6 +362,49 @@ class DiskANNIndex(VectorIndex):
             np.pad(scores, ((0, 0), (0, pad)), constant_values=float("-inf")),
             np.pad(ids, ((0, 0), (0, pad)), constant_values=-1),
         )
+
+    # -- tiering: prefetch + observability -----------------------------------
+
+    def _schedule_prefetch(
+        self, probes: np.ndarray, gens: dict[int, int]
+    ) -> None:
+        """Feed this query's probe set to the successor predictor and
+        hand the predicted NEXT probe set to the async worker, which
+        pages those slabs host->device while the current scan runs on
+        the previous pool references."""
+        if not self.prefetch_enabled:
+            return
+        t0 = time.monotonic()
+        key = tuple(sorted({int(b) for b in np.ravel(probes)}))
+        with self._pf_lock:
+            predicted = self._predictor.observe(key)
+        if predicted is not None:
+            self._prefetcher.submit((predicted, gens))
+        ivf_ops.note_tier_phase("prefetch", t0, time.monotonic())
+
+    def _prefetch_job(self, job: tuple[tuple[int, ...], dict[int, int]]):
+        buckets, gens = job
+        cache = self._cache
+        if cache is None:
+            return
+        fetch = self._make_fetch(gens, self.indexed_count)
+        cache.prefetch(buckets, gens, fetch)
+
+    def tiering_info(self) -> dict[str, Any]:
+        cache = self._cache
+        return {
+            "kind": "diskann",
+            "hbm": cache.stats() if cache is not None else None,
+            "ram": self._ram_tier.stats(),
+            "prefetch": {
+                "enabled": self.prefetch_enabled,
+                "predictor_keys": len(self._predictor),
+                **self._prefetcher.stats(),
+            },
+        }
+
+    def close(self) -> None:
+        self._prefetcher.close()
 
     # -- persistence ---------------------------------------------------------
 
@@ -305,5 +438,6 @@ class DiskANNIndex(VectorIndex):
             self.indexed_count = n
         if self._cache is not None:
             self._cache.invalidate()
+        self._ram_tier.clear()
         # tail rows past the durable count re-absorb from raw vectors
         self.absorb(self.store.count)

@@ -11,11 +11,31 @@ bucket slab: HBM holds a fixed-shape pool of `slots` slabs
     pool_id [slots, cap]    i32     docid per row (-1 padding)
 
 and an LRU map bucket -> slot. A search resolves its probed buckets:
-hits cost nothing; misses gather the bucket's rows from the host mmap
-and land in evicted slots via one batched `dynamic_update_slice` pass.
-Shapes never depend on the request, so the scan kernel compiles once
-per (cap, slots) generation. Appends to a bucket bump its generation,
-turning stale slabs into misses.
+hits cost nothing; misses land in evicted slots via the batched slab
+scatter in tiering/staging.py. Shapes never depend on the request, so
+the scan kernel compiles once per (cap, slots) generation. Appends to
+a bucket bump its generation, turning stale slabs into misses.
+
+Tiered-storage extensions (see docs/TIERING.md):
+
+- **Hot-bucket pinning** — the top `pin_slots` buckets by decayed
+  access frequency are exempt from LRU eviction, so a Zipf-steady
+  workload's hot path launches zero H2D bytes once warmed.
+- **Prefetch** — `prefetch()` uploads predicted next-probe slabs from
+  a background thread; uploads publish by reference swap (the scatter
+  returns NEW pool arrays), so an in-flight scan keeps its old pools
+  and nothing ever retraces. Demand hits on prefetched slabs count in
+  `prefetch_hits`.
+- **Multi-pass degradation** — `plan_passes()` splits a probe set that
+  exceeds the evictable slots into groups; `acquire(restrict=...)`
+  resolves one group per fixed-shape pass, returning slot -1 for the
+  deferred probes (masked in ops/ivf.cached_bucket_scan).
+- **PCIe ledger** — every upload notes its exact bytes through
+  ops/perf_model.note_h2d_bytes; `stats()` exports the per-tier
+  hit/miss/evict/pin counters the PS surfaces.
+
+All public entry points are thread-safe (search threads, the realtime
+absorber and the prefetch worker share one cache).
 
 This is explicit software-managed memory — the design the pallas guide
 prescribes for beyond-HBM working sets, applied at the index level.
@@ -23,76 +43,277 @@ prescribes for beyond-HBM working sets, applied at the index level.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
-from typing import Callable
+from typing import Callable, Iterable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vearch_tpu.ops import ivf as ivf_ops
+from vearch_tpu.ops import perf_model
+from vearch_tpu.tiering.staging import scatter_slabs
+from vearch_tpu.tools import lockcheck
+
+FetchFn = Callable[
+    [int], tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+]
+
+# decayed-frequency bookkeeping: every _DECAY_EVERY resolved buckets the
+# effective count of every bucket halves (applied lazily), so pinning
+# tracks the CURRENT hot set rather than all-time access totals
+_DECAY_EVERY = 1024
+_PIN_MIN_FREQ = 2.0  # a bucket must prove reuse before it can pin
+
 
 class HbmBucketCache:
-    def __init__(self, dimension: int, slots: int, cap: int):
+    _guarded_by = {
+        "_lru": "_lock",
+        "_slot_gen": "_lock",
+        "_free": "_lock",
+        "_pinned": "_lock",
+        "_from_prefetch": "_lock",
+        "_freq": "_lock",
+        "_last_resolved": "_lock",
+    }
+
+    def __init__(
+        self,
+        dimension: int,
+        slots: int,
+        cap: int,
+        pin_slots: int | None = None,
+    ):
         self.dimension = dimension
         self.slots = slots
         self.cap = cap
+        # at least one evictable slot must remain or demand resolves of
+        # unpinned buckets could never claim space
+        self.pin_slots = max(
+            0,
+            min(slots // 4 if pin_slots is None else int(pin_slots),
+                slots - 1),
+        )
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        self.pin_hits = 0
+        self.prefetch_hits = 0
+        self.prefetched = 0
+        self.h2d_bytes = 0
+        self._lock = lockcheck.make_lock("hbm_cache")
         self._lru: OrderedDict[int, int] = OrderedDict()  # bucket -> slot
         self._slot_gen: dict[int, int] = {}  # bucket -> generation cached
         self._free = list(range(slots - 1, -1, -1))
+        self._pinned: set[int] = set()
+        self._from_prefetch: set[int] = set()
+        self._freq: dict[int, tuple[float, int]] = {}
+        self._epoch = 0
+        self._lookups = 0
+        self._last_resolved: set[int] = set()
         self._pool8 = jnp.zeros((slots, cap, dimension), dtype=jnp.int8)
         self._pool_sc = jnp.zeros((slots, cap), dtype=jnp.float32)
         self._pool_sq = jnp.zeros((slots, cap), dtype=jnp.float32)
         self._pool_id = jnp.full((slots, cap), -1, dtype=jnp.int32)
 
     @property
+    def slab_bytes(self) -> int:
+        """H2D bytes one slab upload moves (= perf_model.slab_bytes)."""
+        return perf_model.slab_bytes(self.cap, self.dimension)
+
+    @property
     def hbm_bytes(self) -> int:
-        return self.slots * self.cap * (self.dimension + 12)
+        return self.slots * self.slab_bytes
+
+    # -- demand path --------------------------------------------------
 
     def resolve(
         self,
         buckets: np.ndarray,
         gens: dict[int, int],
-        fetch: Callable[[int], tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+        fetch: FetchFn,
     ) -> np.ndarray:
         """Map unique bucket ids -> device slots, uploading misses.
 
         `gens[b]` is bucket b's current generation; `fetch(b)` returns
         host (q8 [nb, d], scale [nb], vsq [nb], docids [nb]) with
-        nb <= cap. Returns slot ids aligned with `buckets`.
+        nb <= cap. Returns slot ids aligned with `buckets`. Raises when
+        the probe set cannot fit one pass — multi-pass callers use
+        `plan_passes` + `acquire(restrict=...)` instead.
         """
-        uniq = [int(b) for b in np.unique(buckets)]
+        uniq = np.unique(buckets)
         if len(uniq) > self.slots:
             raise ValueError(
                 f"probe set ({len(uniq)} buckets) exceeds cache "
                 f"capacity ({self.slots} slots); raise cache_mb or "
                 f"lower nprobe*batch"
             )
+        with self._lock:
+            return self._resolve_locked(buckets, gens, fetch, None)
+
+    def acquire(
+        self,
+        buckets: np.ndarray,
+        gens: dict[int, int],
+        fetch: FetchFn,
+        restrict: Iterable[int] | None = None,
+    ) -> tuple[np.ndarray, tuple[jax.Array, ...]]:
+        """Resolve + pools as one atomic step: the returned slot array
+        and pool references belong to the same cache state, so a
+        concurrent prefetch upload (which swaps pools by reference)
+        cannot slip between them. With `restrict`, only that bucket
+        subset is resolved; other probes get slot -1 (the scan kernel
+        masks them) for the multi-pass degradation path."""
+        with self._lock:
+            slots = self._resolve_locked(
+                buckets, gens, fetch,
+                None if restrict is None else set(restrict),
+            )
+            pools = (self._pool8, self._pool_sc, self._pool_sq,
+                     self._pool_id)
+            return slots, pools
+
+    def plan_passes(self, buckets: np.ndarray) -> list[list[int]]:
+        """Split a probe set into groups that each fit one fixed-shape
+        pass: pinned buckets keep their slots (cost 0), every other
+        bucket needs one of the `slots - len(pinned)` evictable slots.
+        One group for the common case; never raises."""
+        uniq = [int(b) for b in np.unique(buckets)]
+        with self._lock:
+            limit = max(1, self.slots - len(self._pinned))
+            groups: list[list[int]] = []
+            cur: list[int] = []
+            cost = 0
+            for b in uniq:
+                c = 0 if b in self._pinned else 1
+                if cur and cost + c > limit:
+                    groups.append(cur)
+                    cur, cost = [], 0
+                cur.append(b)
+                cost += c
+            if cur:
+                groups.append(cur)
+            return groups
+
+    def _resolve_locked(self, buckets, gens, fetch, restrict):  # lint: holds[_lock]
+        uniq = [int(b) for b in np.unique(buckets)]
+        active = (
+            uniq if restrict is None
+            else [b for b in uniq if b in restrict]
+        )
         missing: list[int] = []
-        for b in uniq:
+        for b in active:
+            self._touch_freq(b)
             slot = self._lru.get(b)
             if slot is not None and self._slot_gen.get(b) == gens.get(b, 0):
                 self._lru.move_to_end(b)
                 self.hits += 1
+                if b in self._pinned:
+                    self.pin_hits += 1
+                elif b in self._from_prefetch:
+                    self.prefetch_hits += 1
             else:
                 missing.append(b)
                 self.misses += 1
         if missing:
-            self._upload(missing, gens, fetch)
-        slot_of = {b: s for b, s in self._lru.items()}
+            t0 = time.monotonic()
+            self._upload(missing, gens, fetch, protect=frozenset(),
+                         prefetch=False)
+            ivf_ops.note_tier_phase("fetch", t0, time.monotonic())
+        self._last_resolved = set(active)
+        self._recompute_pins()
+        active_set = set(active)
+        slot_of = self._lru
         return np.asarray(
-            [slot_of[int(b)] for b in np.ravel(buckets)], dtype=np.int32
+            [
+                slot_of[b] if b in active_set else -1
+                for b in (int(x) for x in np.ravel(buckets))
+            ],
+            dtype=np.int32,
         ).reshape(np.shape(buckets))
 
-    def _upload(self, missing, gens, fetch) -> None:
-        m = len(missing)
+    # -- prefetch path ------------------------------------------------
+
+    def prefetch(
+        self, buckets: Iterable[int], gens: dict[int, int], fetch: FetchFn
+    ) -> int:
+        """Upload predicted next-probe slabs ahead of demand. Already-
+        resident buckets are marked prefetch-confirmed (their next
+        demand hit counts in prefetch_hits); misses upload without
+        evicting pinned buckets or the most recently resolved set, and
+        without touching the demand hit/miss/frequency accounting.
+        Returns the number of slabs uploaded."""
+        with self._lock:
+            missing: list[int] = []
+            for b in {int(b) for b in buckets}:
+                slot = self._lru.get(b)
+                if slot is not None and self._slot_gen.get(b) == gens.get(b, 0):
+                    self._from_prefetch.add(b)
+                else:
+                    missing.append(b)
+            if not missing:
+                return 0
+            n = self._upload(
+                missing, gens, fetch,
+                protect=frozenset(self._last_resolved), prefetch=True,
+            )
+            self.prefetched += n
+            return n
+
+    # -- internals (lock held) ----------------------------------------
+
+    def _touch_freq(self, bucket: int) -> None:  # lint: holds[_lock]
+        self._lookups += 1
+        if self._lookups % _DECAY_EVERY == 0:
+            self._epoch += 1
+            if len(self._freq) > 8 * self.slots:
+                # shed fully-decayed buckets so the frequency map stays
+                # O(slots), not O(nlist)
+                self._freq = {
+                    b: cf for b, cf in self._freq.items()
+                    if cf[0] * 0.5 ** (self._epoch - cf[1]) >= 0.5
+                }
+        count, epoch = self._freq.get(bucket, (0.0, self._epoch))
+        self._freq[bucket] = (
+            count * (0.5 ** (self._epoch - epoch)) + 1.0,
+            self._epoch,
+        )
+
+    def _recompute_pins(self) -> None:  # lint: holds[_lock]
+        if self.pin_slots <= 0:
+            return
+        t0 = time.monotonic()
+        scored: list[tuple[float, int]] = []
+        for b in self._lru:
+            cf = self._freq.get(b)
+            if cf is None:
+                continue
+            eff = cf[0] * 0.5 ** (self._epoch - cf[1])
+            if eff >= _PIN_MIN_FREQ:
+                scored.append((eff, b))
+        scored.sort(reverse=True)
+        new = {b for _, b in scored[: self.pin_slots]}
+        if new != self._pinned:
+            self._pinned = new
+            ivf_ops.note_tier_phase("pin", t0, time.monotonic())
+
+    def _upload(self, missing, gens, fetch, protect, prefetch) -> int:  # lint: holds[_lock]
+        staged: list[tuple[int, int]] = []  # (bucket, slot)
+        for b in missing:
+            slot = self._claim(b, protect, allow_pin_evict=not prefetch)
+            if slot is None:  # prefetch found nothing evictable: skip
+                continue
+            staged.append((b, slot))
+        if not staged:
+            return 0
+        m = len(staged)
         h8 = np.zeros((m, self.cap, self.dimension), dtype=np.int8)
         hsc = np.zeros((m, self.cap), dtype=np.float32)
         hsq = np.zeros((m, self.cap), dtype=np.float32)
         hid = np.full((m, self.cap), -1, dtype=np.int32)
         slots = np.zeros(m, dtype=np.int32)
-        for j, b in enumerate(missing):
+        for j, (b, slot) in enumerate(staged):
             q8, sc, sq, ids = fetch(b)
             nb = q8.shape[0]
             assert nb <= self.cap, f"bucket {b} ({nb} rows) > cap {self.cap}"
@@ -100,45 +321,106 @@ class HbmBucketCache:
             hsc[j, :nb] = sc
             hsq[j, :nb] = sq
             hid[j, :nb] = ids
-            slots[j] = self._claim(b)
+            slots[j] = slot
             self._slot_gen[b] = gens.get(b, 0)
+            if prefetch:
+                self._from_prefetch.add(b)
+            else:
+                self._from_prefetch.discard(b)
+        nbytes = h8.nbytes + hsc.nbytes + hsq.nbytes + hid.nbytes
+        self.h2d_bytes += nbytes
+        perf_model.note_h2d_bytes(nbytes)
         self._pool8, self._pool_sc, self._pool_sq, self._pool_id = (
-            _scatter_slabs(
+            scatter_slabs(
                 self._pool8, self._pool_sc, self._pool_sq, self._pool_id,
                 jnp.asarray(h8), jnp.asarray(hsc), jnp.asarray(hsq),
                 jnp.asarray(hid), jnp.asarray(slots),
             )
         )
+        return m
 
-    def _claim(self, bucket: int) -> int:
+    def _claim(self, bucket, protect, allow_pin_evict) -> int | None:  # lint: holds[_lock]
         old = self._lru.pop(bucket, None)
-        if old is not None:
+        if old is not None:  # stale-generation re-upload: keep the slot
             self._lru[bucket] = old
             return old
         if self._free:
             slot = self._free.pop()
-        else:
-            evicted, slot = self._lru.popitem(last=False)
-            self._slot_gen.pop(evicted, None)
+            self._lru[bucket] = slot
+            return slot
+        victim = next(
+            (b for b in self._lru
+             if b not in protect and b not in self._pinned),
+            None,
+        )
+        if victim is None and allow_pin_evict:
+            # demand must succeed: fall back to evicting a pinned (then
+            # any) bucket rather than failing the search
+            victim = next(
+                (b for b in self._lru if b not in protect), None
+            )
+            if victim is None:
+                victim = next(iter(self._lru))
+        if victim is None:
+            return None
+        slot = self._lru.pop(victim)
+        self._slot_gen.pop(victim, None)
+        self._from_prefetch.discard(victim)
+        self._pinned.discard(victim)
+        self.evictions += 1
         self._lru[bucket] = slot
         return slot
+
+    # -- introspection ------------------------------------------------
 
     def pools(self) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
         return self._pool8, self._pool_sc, self._pool_sq, self._pool_id
 
+    def stats(self) -> dict[str, int]:
+        """Tiering counters the PS metrics and /ps/stats export."""
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "pin_hits": self.pin_hits,
+                "prefetch_hits": self.prefetch_hits,
+                "prefetched": self.prefetched,
+                "h2d_bytes": self.h2d_bytes,
+                "pinned": len(self._pinned),
+                "pin_slots": self.pin_slots,
+                "resident": len(self._lru),
+                "slots": self.slots,
+                "cap": self.cap,
+                "slab_bytes": self.slab_bytes,
+                "resident_bytes": len(self._lru) * self.slab_bytes,
+                "hbm_bytes": self.hbm_bytes,
+            }
+
+    def seed_counters(self, stats: dict[str, int]) -> None:
+        """Carry lifetime counters across a cache rebuild (capacity
+        regrow) so operator-facing hit rates don't reset mid-flight."""
+        with self._lock:
+            self.hits += int(stats.get("hits", 0))
+            self.misses += int(stats.get("misses", 0))
+            self.evictions += int(stats.get("evictions", 0))
+            self.pin_hits += int(stats.get("pin_hits", 0))
+            self.prefetch_hits += int(stats.get("prefetch_hits", 0))
+            self.prefetched += int(stats.get("prefetched", 0))
+            self.h2d_bytes += int(stats.get("h2d_bytes", 0))
+
     def invalidate(self) -> None:
-        self._lru.clear()
-        self._slot_gen.clear()
-        self._free = list(range(self.slots - 1, -1, -1))
-        self.hits = 0
-        self.misses = 0
-
-
-@jax.jit
-def _scatter_slabs(p8, psc, psq, pid, h8, hsc, hsq, hid, slots):
-    """Scatter m uploaded slabs into their pool slots in one dispatch."""
-    p8 = p8.at[slots].set(h8)
-    psc = psc.at[slots].set(hsc)
-    psq = psq.at[slots].set(hsq)
-    pid = pid.at[slots].set(hid)
-    return p8, psc, psq, pid
+        with self._lock:
+            self._lru.clear()
+            self._slot_gen.clear()
+            self._free = list(range(self.slots - 1, -1, -1))
+            self._pinned.clear()
+            self._from_prefetch.clear()
+            self._freq.clear()
+            self._last_resolved = set()
+            self.hits = 0
+            self.misses = 0
+            self.evictions = 0
+            self.pin_hits = 0
+            self.prefetch_hits = 0
+            self.prefetched = 0

@@ -207,6 +207,43 @@ def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
                    else "admission shed rate within bounds"),
     })
 
+    # prefetch effectiveness: a disk-tier partition with prefetch on
+    # and real traffic should be landing most lookups on pinned or
+    # prefetch-confirmed slabs — a low pin+prefetch hit share means the
+    # predictor is thrashing (transfers on the critical path) and the
+    # operator should raise cache_mb/pin_slots or disable prefetch
+    thrashing = []
+    observed = 0
+    for srv in report.get("servers", []):
+        parts = (srv.get("stats") or {}).get("partitions") or {}
+        for pid, part in parts.items():
+            fields = ((part.get("tiering") or {}).get("fields") or {})
+            for fname, tier in fields.items():
+                hbm = tier.get("hbm") or {}
+                pf = tier.get("prefetch") or {}
+                lookups = int(hbm.get("hits") or 0) + int(
+                    hbm.get("misses") or 0
+                )
+                if not pf.get("enabled") or lookups < 512:
+                    continue
+                observed += 1
+                served = int(hbm.get("pin_hits") or 0) + int(
+                    hbm.get("prefetch_hits") or 0
+                )
+                if served < 0.5 * lookups:
+                    thrashing.append(
+                        f"node {srv.get('node_id')} partition {pid} "
+                        f"field {fname}: {served}/{lookups} "
+                        f"pin+prefetch hits"
+                    )
+    checks.append({
+        "name": "prefetch_effectiveness", "ok": not thrashing,
+        "detail": ("; ".join(thrashing) if thrashing
+                   else (f"{observed} disk-tier field(s) serving from "
+                         f"pinned/prefetched slabs" if observed
+                         else "no disk-tier traffic to judge")),
+    })
+
     try:
         ok, detail = _check_obs_docs()
     except Exception as e:

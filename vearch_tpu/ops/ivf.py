@@ -68,7 +68,7 @@ _capture_tls = threading.local()
 
 
 class DispatchCapture:
-    __slots__ = ("events", "mesh_phases")
+    __slots__ = ("events", "mesh_phases", "tier_phases")
 
     def __init__(self) -> None:
         # [tag, start_monotonic_s, end_monotonic_s | None] — consumers
@@ -79,6 +79,10 @@ class DispatchCapture:
         # of the mesh serving path (shard placement, mask upload, ...)
         # — replayed by the engine as mesh.{name} phase spans
         self.mesh_phases: list[tuple[str, float, float]] = []
+        # (name, start_monotonic_s, end_monotonic_s) host-side windows
+        # of the tiered-storage path (demand fetch, prefetch schedule,
+        # pin-set change) — replayed as tier.{name} phase spans
+        self.tier_phases: list[tuple[str, float, float]] = []
 
     def note(self, tag: str) -> None:
         now = time.monotonic()
@@ -132,6 +136,17 @@ def note_mesh_phase(name: str, t0: float, t1: float) -> None:
     cap = getattr(_capture_tls, "capture", None)
     if cap is not None:
         cap.mesh_phases.append((name, t0, t1))
+
+
+def note_tier_phase(name: str, t0: float, t1: float) -> None:
+    """Record a host-side window of the tiered-storage serving path
+    (demand slab fetch, prefetch scheduling, pin-set recompute) on the
+    current request's capture — shows up as a tier.{name} phase span
+    next to the kernel.* dispatch spans. No-op off the request thread
+    (the async prefetch worker has no capture installed)."""
+    cap = getattr(_capture_tls, "capture", None)
+    if cap is not None:
+        cap.tier_phases.append((name, t0, t1))
 
 
 def _coarse_probes(
@@ -475,6 +490,11 @@ def cached_bucket_scan(
 
     def step(best, pr):
         s = probe_slots[:, pr]  # [B]
+        # slot -1 marks a probe deferred to another fixed-shape pass
+        # (multi-pass resolve when the probe set exceeds cache slots):
+        # clamp the gather and mask the whole slab out of the fold
+        slot_ok = s >= 0  # [B]
+        s = jnp.maximum(s, 0)
         slab8 = pool8[s]  # [B, cap, d]
         ids = pool_ids[s]  # [B, cap]
         vsq = pool_vsq[s]
@@ -487,7 +507,7 @@ def cached_bucket_scan(
             scores = -(q_sq[:, None] - 2.0 * dots + vsq)
         else:
             scores = dots
-        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)]
+        ok = (ids >= 0) & valid[jnp.maximum(ids, 0)] & slot_ok[:, None]
         scores = jnp.where(ok, scores, NEG_INF)
         return _fold_topk(best, scores, ids), None
 

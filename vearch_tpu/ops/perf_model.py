@@ -286,6 +286,37 @@ def h2d_bytes_total() -> int:
         return _h2d_bytes_total
 
 
+# -- bytes-over-PCIe model (tiered storage engine) --------------------------
+#
+# The disk tier's only per-query H2D traffic with a warm cache is ZERO:
+# a hit serves entirely from the resident HBM slab pools. A miss pays
+# exactly one slab upload — four arrays of fixed shape [cap, ...]:
+#
+#     int8 rows   cap * d   bytes
+#     scale f32   cap * 4
+#     vsq   f32   cap * 4
+#     docids i32  cap * 4
+#
+# so slab_bytes(cap, d) = cap * (d + 12), and a resolve with `m` misses
+# moves tier_h2d_bytes(m, cap, d) = m * slab_bytes over PCIe (the slot
+# index vector rides in the dispatch, not the ledger). HbmBucketCache
+# notes the actual uploaded nbytes through note_h2d_bytes, and
+# tests/test_perf_gates.py asserts ledger delta == model exactly:
+# zero for a warmed hot working set, m * slab_bytes on cold misses.
+
+
+def slab_bytes(cap: int, d: int) -> int:
+    """H2D bytes one bucket-slab upload moves (int8 rows + scale + vsq
+    + docids at the cache's fixed row capacity `cap`)."""
+    return int(cap) * (int(d) + 12)
+
+
+def tier_h2d_bytes(misses: int, cap: int, d: int) -> int:
+    """Modeled PCIe bytes for a resolve with `misses` slab misses —
+    zero on a full hit, one slab_bytes per missed bucket otherwise."""
+    return int(misses) * slab_bytes(cap, d)
+
+
 # -- 3. bytes-materialized model --------------------------------------------
 
 

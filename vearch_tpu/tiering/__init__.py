@@ -1,0 +1,41 @@
+"""Tiered storage engine: HBM <-> host RAM <-> NVMe.
+
+The reference serves beyond-RAM partitions through gamma's disk tiers
+(RocksDB-backed RawVector; the DISKANN_STATIC tier keeps compressed
+codes in RAM and full vectors on disk). The TPU-native analogue pages
+IVF bucket *slabs* instead of graph nodes, and this package is the
+machinery between the NVMe mmaps and the HBM bucket cache:
+
+    NVMe   approx8.i8 / meta2.f32 / raw.f32 mmaps (index/disk.py,
+           engine/disk_vector.py) — durable, page-cache backed
+    RAM    HostRamSlabTier / HostRowCache (ram_tier.py) — frequency-
+           admitted slab and row copies, so an HBM miss costs a memcpy,
+           not a page fault storm
+    HBM    HbmBucketCache (index/hbm_cache.py) — fixed-shape slab
+           pools, hot-bucket pinning, LRU for the rest
+
+`staging.py` owns the one jitted program of the subsystem: the batched
+slab scatter that lands uploaded buckets in their pool slots. Because
+`pool.at[slots].set(...)` returns a NEW pool, every upload is a staging
+pool swapped in by reference — an in-flight scan keeps the old arrays,
+so the async prefetch worker (prefetch.py) can page next-probe slabs
+while the current scan runs without ever changing a shape.
+
+The perf contract lives in ops/perf_model.py (`slab_bytes`,
+`tier_h2d_bytes`, `note_h2d_bytes`) and is gated in
+tests/test_perf_gates.py: a warmed hot-working-set search launches
+ZERO H2D bytes; a cold miss pays exactly the modeled slab bytes.
+See docs/TIERING.md for the tier map, knobs and runbook.
+"""
+
+from vearch_tpu.tiering.prefetch import PrefetchWorker, SequencePredictor
+from vearch_tpu.tiering.ram_tier import HostRamSlabTier, HostRowCache
+from vearch_tpu.tiering.staging import scatter_slabs
+
+__all__ = [
+    "HostRamSlabTier",
+    "HostRowCache",
+    "PrefetchWorker",
+    "SequencePredictor",
+    "scatter_slabs",
+]

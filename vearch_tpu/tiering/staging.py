@@ -1,0 +1,36 @@
+"""Device staging for the tiered storage engine.
+
+One jitted program: scatter a batch of uploaded bucket slabs into their
+HBM pool slots. `Array.at[slots].set(...)` returns a NEW pool array, so
+every upload builds a *staging* pool that the cache swaps in by
+reference assignment — an in-flight scan holds the previous arrays and
+finishes against them unchanged. That reference swap IS the double
+buffer: shapes are fixed at (slots, cap, d), so neither the scatter nor
+the downstream scan ever retraces, and the H2D cost of an upload is
+exactly ops/perf_model.slab_bytes(cap, d) per slab (gated in
+tests/test_perf_gates.py via note_h2d_bytes).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from vearch_tpu.ops.perf_model import register_jit
+
+
+@jax.jit
+def _scatter_slabs(p8, psc, psq, pid, h8, hsc, hsq, hid, slots):
+    """Scatter m uploaded slabs into their pool slots in one dispatch.
+
+    Inputs: pools [slots, cap, ...], host slabs [m, cap, ...], slot ids
+    [m] i32. Returns the four staged pools (new arrays — the caller
+    publishes them by reference assignment).
+    """
+    p8 = p8.at[slots].set(h8)
+    psc = psc.at[slots].set(hsc)
+    psq = psq.at[slots].set(hsq)
+    pid = pid.at[slots].set(hid)
+    return p8, psc, psq, pid
+
+
+scatter_slabs = register_jit("tiering.scatter_slabs", _scatter_slabs)

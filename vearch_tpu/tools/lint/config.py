@@ -18,6 +18,9 @@ DISPATCH_PACKAGES = (
     # first-class dispatch sources, registered in the perf model's jit
     # registry like every ops/ program
     "vearch_tpu/parallel/",
+    # the tiered storage engine: the staged slab scatter is the one
+    # device program of the subsystem, registered in the jit registry
+    "vearch_tpu/tiering/",
 )
 
 # Names whose call or decorator use counts as creating a dispatchable
