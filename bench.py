@@ -487,6 +487,147 @@ def tiered_storage_bench(dry: bool) -> dict:
         resident.close()
 
 
+def continuous_batching_bench(dry: bool) -> dict:
+    """Continuous-batching scheduler (docs/PERF.md Tier 7): a mixed-
+    (k, rows) open-loop workload through the padded-shape-bucket
+    scheduler vs the fixed exact-key micro-batcher it replaced
+    (`shape_buckets` off). Reports dispatches per query, padding-waste
+    share, QPS both ways — and asserts bucketed co-batching is
+    bit-identical to solo runs, because a batching win that changes
+    results is not a win. The fixed batcher can NOT make that claim:
+    its unpadded group shapes hit different XLA reduction strategies
+    than a 1-row solo run (gemv vs gemm), so its scores drift in the
+    low f32 bits — declared row buckets are what pin every request,
+    solo or grouped, to the same program family. Across configs only
+    the returned top-k keys are compared, for the same reason."""
+    import threading
+
+    from vearch_tpu.engine.engine import Engine, SearchRequest
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+
+    d = 32
+    n_docs, n_reqs, n_workers = (2_000, 240, 16) if dry \
+        else (200_000, 4_000, 32)
+    rng = np.random.default_rng(11)
+    base = rng.standard_normal((n_docs, d)).astype(np.float32)
+
+    # the request mix: mostly single-row lookups at small k, some
+    # 2-4 row callers, a few deep-k — the traffic shape that fragmented
+    # the old exact-key batcher into solo dispatches
+    reqs = []
+    for i in range(n_reqs):
+        rows = (1, 1, 1, 2, 4)[i % 5]
+        k = (3, 5, 10, 10, 20)[i % 5]
+        reqs.append((rng.standard_normal((rows, d)).astype(np.float32), k))
+
+    def run(shape_buckets: bool):
+        schema = TableSchema("cb", [
+            FieldSchema("v", DataType.VECTOR, dimension=d,
+                        index=IndexParams("FLAT", MetricType.L2, {})),
+        ])
+        eng = Engine(schema)
+        try:
+            eng.upsert([{"_id": str(i), "v": base[i]}
+                        for i in range(n_docs)])
+            eng.build_index()
+            eng.apply_config({"shape_buckets": shape_buckets})
+            # warm: one solo query per k so neither run pays first-
+            # compile inside the measured window
+            for _, k in set((0, k) for _, k in reqs):
+                eng.search(SearchRequest(vectors={"v": base[0]}, k=k,
+                                         include_fields=[]))
+            out = [None] * n_reqs
+            errs = []
+            it = iter(range(n_reqs))
+            lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with lock:
+                        i = next(it, None)
+                    if i is None:
+                        return
+                    q, k = reqs[i]
+                    try:
+                        out[i] = eng.search(SearchRequest(
+                            vectors={"v": q}, k=k, include_fields=[]))
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+                        return
+
+            mb0 = eng._microbatcher
+            d0 = mb0.dispatches if mb0 else 0
+            threads = [threading.Thread(target=worker, daemon=True,
+                                        name=f"bench-cb-{t}")
+                       for t in range(n_workers)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.time() - t0
+            if errs:
+                raise errs[0]
+            mb = eng._microbatcher
+            st = mb.stats() if mb else {}
+
+            def flat(res):
+                return [(it_.key, float(it_.score))
+                        for it_ in res[0].items] if res else None
+
+            # solo reference on the SAME config: identical padded
+            # shapes -> identical program -> the scheduler's results
+            # must match bit for bit
+            solo = [eng._search_direct(SearchRequest(
+                vectors={"v": q}, k=k, include_fields=[]))
+                for q, k in reqs]
+            return {
+                "qps": round(n_reqs / dt, 1),
+                "dispatches": int(st.get("dispatches", 0)) - d0,
+                "batched_requests": int(st.get("batched_requests", 0)),
+                "occupancy_pct": st.get("occupancy_pct", 0.0),
+                "pad_real_rows": int(eng.pad_real_rows),
+                "pad_padded_rows": int(eng.pad_padded_rows),
+                "results": [flat(r) for r in out],
+                "solo_results": [flat(r) for r in solo],
+            }
+        finally:
+            eng.close()
+
+    tiered = run(True)
+    fixed = run(False)
+    identical = tiered["results"] == tiered.pop("solo_results")
+    fixed_identical = fixed["results"] == fixed.pop("solo_results")
+    same_topk = (
+        [[key for key, _ in r] for r in tiered.pop("results")]
+        == [[key for key, _ in r] for r in fixed.pop("results")]
+    )
+    padded = max(tiered["pad_padded_rows"], 1)
+    waste_pct = round(
+        100.0 * (tiered["pad_padded_rows"] - tiered["pad_real_rows"])
+        / padded, 1)
+    return {
+        "n_docs": n_docs, "n_reqs": n_reqs, "workers": n_workers,
+        "bucketed_bit_identical_vs_solo": identical,
+        "fixed_bit_identical_vs_solo": fixed_identical,
+        "same_topk_vs_fixed": same_topk,
+        "bucketed_dispatches_per_query": round(
+            tiered["dispatches"] / n_reqs, 3),
+        "fixed_dispatches_per_query": round(
+            fixed["dispatches"] / n_reqs, 3),
+        "dispatch_reduction_x": round(
+            fixed["dispatches"] / max(tiered["dispatches"], 1), 2),
+        "padding_waste_pct": waste_pct,
+        "bucket_occupancy_pct": tiered["occupancy_pct"],
+        "bucketed_qps": tiered["qps"],
+        "fixed_qps": fixed["qps"],
+        "bucketed_batched_requests": tiered["batched_requests"],
+        "fixed_batched_requests": fixed["batched_requests"],
+    }
+
+
 def main():
     if _dryrun():
         import jax as _jax
@@ -703,6 +844,19 @@ def main():
         emit("tiered_storage", **tier_diag)
     else:
         emit("tiered_storage_resumed", **tier_diag)
+
+    # -- continuous batching (scheduler tentpole): mixed-(k, rows)
+    # traffic through shape buckets vs the fixed exact-key batcher.
+    # Resumable like the tail phase; never kills the headline.
+    cb_diag = _phase_cached(partial_path, "continuous_batching")
+    if cb_diag is None:
+        try:
+            cb_diag = continuous_batching_bench(_dryrun())
+        except Exception as e:
+            cb_diag = {"error": f"{type(e).__name__}: {e}"}
+        emit("continuous_batching", **cb_diag)
+    else:
+        emit("continuous_batching_resumed", **cb_diag)
 
     # -- per-phase breakdown (r4 review next-1: the captured headline
     # must be decomposable — where does the wall time go?) ------------

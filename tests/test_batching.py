@@ -1,0 +1,388 @@
+"""Continuous-batching scheduler: concurrent searches pack into padded
+shape buckets and share device dispatches without changing any result
+(engine/batching.py; successor to the fixed micro-batcher)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from vearch_tpu.engine.batching import (
+    BatchScheduler, _Bucket, _compat_key, _Pending, _rows_of,
+)
+from vearch_tpu.engine.engine import (
+    Engine, RequestContext, RequestKilled, SearchRequest,
+)
+from vearch_tpu.engine.types import (
+    DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+)
+
+D, N = 16, 3000
+
+SCORE_ASC = [{"field": "_score", "desc": False, "missing_first": False}]
+
+
+@pytest.fixture(scope="module")
+def engine_and_data():
+    rng = np.random.default_rng(2)
+    base = rng.standard_normal((N, D)).astype(np.float32)
+    schema = TableSchema("m", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": str(i), "v": base[i]} for i in range(N)])
+    eng.build_index()
+    yield eng, base
+    eng.close()
+
+
+def _bucket_of(pendings):
+    b = _Bucket("t")
+    for p in pendings:
+        b.pendings.append(p)
+        b.rows += p.rows
+    return b
+
+
+def test_compat_key_mixes_k_within_tier():
+    """Plain requests co-batch across differing k inside one fetch-k
+    tier (the engine scans at the tier depth either way); crossing a
+    tier boundary still splits the bucket."""
+    a = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5)
+    b = SearchRequest(vectors={"v": np.zeros((1, D))}, k=9)
+    big = SearchRequest(vectors={"v": np.zeros((1, D))}, k=20)
+    c = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                      index_params={"nprobe": 4})
+    assert _compat_key(a) == _compat_key(b)  # both in the k<=16 tier
+    assert _compat_key(a) != _compat_key(big)  # tier 16 vs tier 64
+    assert _compat_key(a) != _compat_key(c)  # params split buckets
+    # without tiering (shape_buckets off) exact k splits again
+    assert _compat_key(a, tiered=False) != _compat_key(b, tiered=False)
+
+
+def test_compat_key_sort_and_bounds_need_exact_k():
+    """Result shaping (sort, score window) applies at the group's k, so
+    trimming a deeper group afterwards would diverge from the solo run:
+    sorted/bounded requests only co-batch on exact k."""
+    s5 = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                       sort=SCORE_ASC)
+    s9 = SearchRequest(vectors={"v": np.zeros((1, D))}, k=9,
+                       sort=SCORE_ASC)
+    s5b = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                        sort=SCORE_ASC)
+    assert _compat_key(s5) != _compat_key(s9)
+    assert _compat_key(s5) == _compat_key(s5b)
+    b5 = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                       score_bounds={"v": (None, 1.0)})
+    b9 = SearchRequest(vectors={"v": np.zeros((1, D))}, k=9,
+                       score_bounds={"v": (None, 1.0)})
+    plain5 = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5)
+    assert _compat_key(b5) != _compat_key(b9)
+    assert _compat_key(b5) != _compat_key(plain5)
+
+
+def test_dispatcher_survives_poison_request(engine_and_data):
+    """A request whose grouping key cannot be built fails loudly but the
+    dispatcher thread stays alive for later callers."""
+    eng, base = engine_and_data
+
+    class Unprintable:
+        def __str__(self):
+            raise RuntimeError("boom")
+
+    mb = BatchScheduler(eng, max_rows=64)
+    try:
+        bad = SearchRequest(vectors={"v": base[0]}, k=2,
+                            include_fields=[],
+                            index_params={"poison": Unprintable()})
+        with pytest.raises(Exception):
+            mb.submit(bad)
+        # the same scheduler still serves well-formed requests
+        good = mb.submit(SearchRequest(vectors={"v": base[4]}, k=2,
+                                       include_fields=[]))
+        assert good[0].items[0].key == "4"
+    finally:
+        mb.stop()
+
+
+def test_bucket_seals_at_capacity_and_drains_on_close(engine_and_data):
+    """A bucket dispatches the moment it fills; a partial bucket held
+    back by the age bound never hangs its caller past stop() — every
+    waiter is errored at close."""
+    eng, base = engine_and_data
+    # huge age bound: only FULL buckets dispatch during the test
+    mb = BatchScheduler(eng, max_rows=4, max_delay_ms=3_600_000.0)
+    done, errs = [], []
+
+    def worker(i):
+        try:
+            done.append(mb.submit(SearchRequest(
+                vectors={"v": np.stack([base[i], base[i + 1]])}, k=2,
+                include_fields=[])))
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"batch-cap-{i}") for i in range(3)]
+    for t in threads:
+        t.start()
+    # two of the three 2-row requests fill the 4-row bucket and return;
+    # the third sits in a fresh open bucket behind the age bound
+    for _ in range(200):
+        if len(done) >= 2:
+            break
+        threading.Event().wait(0.05)
+    assert len(done) == 2 and not errs
+    st = mb.stats()
+    assert st["full_dispatches"] >= 1
+    assert st["open_buckets"] == 1 and st["open_rows"] == 2
+    # drain-on-close: the held-back caller gets an error, not a hang
+    mb.stop()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert len(errs) == 1 and "engine closed" in str(errs[0])
+
+
+def test_batched_results_equal_direct(engine_and_data):
+    """The load-bearing property: batching never changes a result —
+    across mixed k (within and across fetch-k tiers), sorted, and
+    score-bounded traffic."""
+    eng, base = engine_and_data
+    rng = np.random.default_rng(7)
+    reqs = []
+    for i in range(40):
+        q = base[i] + 0.01 * rng.standard_normal(D).astype(np.float32)
+        kw = {}
+        if i % 7 == 3:
+            kw["sort"] = SCORE_ASC
+        elif i % 7 == 5:
+            kw["score_bounds"] = {"v": (None, 5.0)}
+        reqs.append(SearchRequest(
+            vectors={"v": q}, k=(3, 5, 10, 20)[i % 4],
+            include_fields=[], **kw))
+    direct = [eng._search_direct(r) for r in reqs]
+
+    out = [None] * len(reqs)
+    errs = []
+
+    def worker(i):
+        try:
+            out[i] = eng.search(reqs[i])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for i in range(len(reqs)):
+        got = [(it.key, round(it.score, 4)) for it in out[i][0].items]
+        want = [(it.key, round(it.score, 4)) for it in direct[i][0].items]
+        assert got == want, (i, got, want)
+    # with 40 concurrent callers at least some dispatches combined
+    mb = eng._microbatcher
+    assert mb is not None and mb.batched_requests >= 2, (
+        mb.batches, mb.batched_requests
+    )
+
+
+def test_mixed_k_trimmed_per_caller(engine_and_data):
+    eng, base = engine_and_data
+    r3 = SearchRequest(vectors={"v": base[5]}, k=3, include_fields=[])
+    r7 = SearchRequest(vectors={"v": base[6]}, k=7, include_fields=[])
+    mb = BatchScheduler(eng, max_rows=64)
+    try:
+        p3, p7 = _Pending(r3, 1), _Pending(r7, 1)
+        mb._run_bucket(_bucket_of([p3, p7]))
+        assert p3.error is None and p7.error is None
+        assert len(p3.results[0].items) == 3
+        assert len(p7.results[0].items) == 7
+        assert p3.results[0].items[0].key == "5"
+        assert p7.results[0].items[0].key == "6"
+    finally:
+        mb.stop()
+
+
+def test_killed_subrequest_aborts_alone(engine_and_data):
+    eng, base = engine_and_data
+    ctx = RequestContext("r1")
+    ctx.kill("test kill")
+    rk = SearchRequest(vectors={"v": base[1]}, k=3, include_fields=[],
+                       ctx=ctx)
+    ro = SearchRequest(vectors={"v": base[2]}, k=3, include_fields=[])
+    mb = BatchScheduler(eng, max_rows=64)
+    try:
+        pk, po = _Pending(rk, 1), _Pending(ro, 1)
+        mb._run_bucket(_bucket_of([pk, po]))
+        assert isinstance(pk.error, RequestKilled)
+        assert po.error is None
+        assert po.results[0].items[0].key == "2"
+    finally:
+        mb.stop()
+
+
+def test_filtered_requests_bypass_batcher(engine_and_data):
+    eng, base = engine_and_data
+    schema = TableSchema("f", [
+        FieldSchema("tag", DataType.INT),
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    e2 = Engine(schema)
+    e2.upsert([{"_id": str(i), "tag": i % 2, "v": base[i]}
+               for i in range(200)])
+    e2.build_index()
+    res = e2.search(SearchRequest(
+        vectors={"v": base[3]}, k=4, include_fields=["tag"],
+        filters={"operator": "AND",
+                 "conditions": [{"field": "tag", "operator": "=",
+                                 "value": 1}]},
+    ))
+    assert all(r.fields["tag"] == 1 for r in res[0].items)
+    assert e2._microbatcher is None  # filtered path never started one
+    e2.close()
+
+
+def test_runtime_config_disables_batching(engine_and_data):
+    eng, base = engine_and_data
+    eng.apply_config({"micro_batch": False})
+    try:
+        eng.search(SearchRequest(vectors={"v": base[0]}, k=2,
+                                 include_fields=[]))
+        before = eng._microbatcher.batches if eng._microbatcher else 0
+        eng.search(SearchRequest(vectors={"v": base[0]}, k=2,
+                                 include_fields=[]))
+        after = eng._microbatcher.batches if eng._microbatcher else 0
+        assert before == after
+    finally:
+        eng.apply_config({"micro_batch": True})
+
+
+def test_group_failure_isolated_to_bad_request(engine_and_data):
+    """A co-batched request that poisons the SHARED dispatch (wrong
+    dimension makes the stack/concat or the device call fail) must not
+    fail its companymates: the bucket falls back to per-request runs and
+    only the bad request errors."""
+    eng, base = engine_and_data
+    mb = BatchScheduler(eng, max_rows=64)
+    try:
+        good = _Pending(SearchRequest(vectors={"v": base[1]}, k=2,
+                                      include_fields=[]), 1)
+        bad = _Pending(SearchRequest(
+            vectors={"v": np.zeros(D + 1, np.float32)}, k=2,
+            include_fields=[]), 1)
+        mb._run_bucket(_bucket_of([good, bad]))
+        assert good.done.is_set() and bad.done.is_set()
+        assert good.error is None
+        assert good.results[0].items[0].key == "1"
+        assert bad.error is not None
+    finally:
+        mb.stop()
+
+
+def test_apply_config_cannot_reenable_batching_after_close():
+    """close() stops the dispatcher; a late apply_config must not arm
+    the lazy-create path again (it would leak a dispatcher thread bound
+    to a closed engine)."""
+    schema = TableSchema("mc", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": "0", "v": np.zeros(D, np.float32)}])
+    eng.build_index()
+    eng.close()
+    eng.apply_config({"micro_batch": True})
+    assert eng.micro_batch is False
+    res = eng.search(SearchRequest(vectors={"v": np.zeros(D, np.float32)},
+                                   k=1, include_fields=[]))
+    assert res[0].items[0].key == "0"
+    assert eng._microbatcher is None
+
+
+def test_batch_delay_holds_partial_buckets(engine_and_data):
+    """batch_delay_ms > 0: a lone request waits up to the age bound for
+    company, then dispatches anyway (age_timeout_fires counts it)."""
+    eng, base = engine_and_data
+    mb = BatchScheduler(eng, max_rows=64, max_delay_ms=30.0)
+    try:
+        before = mb.age_timeout_fires
+        res = mb.submit(SearchRequest(vectors={"v": base[9]}, k=2,
+                                      include_fields=[]))
+        assert res[0].items[0].key == "9"
+        assert mb.age_timeout_fires == before + 1
+    finally:
+        mb.stop()
+
+
+def test_scheduler_stress_under_lockcheck(rng):
+    """VEARCH_LOCKCHECK=1 stress: the scheduler lock is a named
+    DebugLock recording the acquisition graph while submits, absorbs
+    (upsert + build), and a close race. Zero lock-discipline violations
+    and no hung caller."""
+    from vearch_tpu.tools import lockcheck
+
+    lockcheck.reset()
+    lockcheck.enable()  # BEFORE construction: locks are minted at init
+    try:
+        schema = TableSchema("lk", [
+            FieldSchema("v", DataType.VECTOR, dimension=D,
+                        index=IndexParams("FLAT", MetricType.L2, {})),
+        ])
+        eng = Engine(schema)
+        base = rng.standard_normal((600, D)).astype(np.float32)
+        eng.upsert([{"_id": str(i), "v": base[i]} for i in range(400)])
+        eng.build_index()
+
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def searcher(tid: int):
+            i = tid
+            while not stop.is_set():
+                try:
+                    eng.search(SearchRequest(
+                        vectors={"v": base[i % 400]},
+                        k=(3, 10)[i % 2], include_fields=[]))
+                except RuntimeError as e:
+                    if "engine closed" in str(e) or "closed" in str(e):
+                        return  # expected once the closer wins the race
+                    errors.append(e)
+                    return
+                except Exception as e:
+                    errors.append(e)
+                    return
+                i += 2
+
+        def writer():
+            try:
+                for b in range(4):
+                    lo = 400 + b * 50
+                    eng.upsert([{"_id": str(i), "v": base[i]}
+                                for i in range(lo, lo + 50)])
+            except Exception as e:
+                if "closed" not in str(e):
+                    errors.append(e)
+
+        threads = [threading.Thread(target=searcher, args=(t,),
+                                    daemon=True, name=f"sched-s{t}")
+                   for t in range(4)]
+        threads += [threading.Thread(target=writer, daemon=True,
+                                     name="sched-w")]
+        for t in threads:
+            t.start()
+        threading.Event().wait(0.5)
+        stop.set()
+        eng.close()  # races the in-flight submits
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "hung caller"
+        assert not errors, errors
+        assert lockcheck.violations() == [], lockcheck.violations()
+    finally:
+        lockcheck.reset()

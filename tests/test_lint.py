@@ -58,7 +58,7 @@ def test_list_rules_names_every_rule():
         capture_output=True, text=True, cwd=REPO,
     )
     assert out.returncode == 0
-    for rid in ("VL101", "VL102", "VL201", "VL202", "VL203",
+    for rid in ("VL101", "VL102", "VL103", "VL201", "VL202", "VL203",
                 "VL301", "VL302", "VL401"):
         assert rid in out.stdout, rid
 
@@ -138,6 +138,54 @@ def test_vl102_inline_allow_suppresses(tmp_path):
                 return q
         """)
     assert found == []
+
+
+def test_vl103_redeclared_tier_literal_fires(tmp_path):
+    """Serving code minting its own shape grid (instead of importing the
+    perf model's) breaks the zero-retrace bound silently — VL103."""
+    found = _lint_file(tmp_path, "vearch_tpu/engine/rogue.py", """\
+        MY_ROW_BUCKETS = (8, 32)
+
+        def pick(n):
+            return min(b for b in MY_ROW_BUCKETS if b >= n)
+        """)
+    assert _rules(found) == ["VL103"]
+    assert "re-declare" in found[0].message
+
+
+def test_vl103_import_and_inline_allow_pass(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/engine/fine.py", """\
+        from vearch_tpu.ops import perf_model
+
+        def pick(n):
+            return perf_model.bucket_rows(n)
+
+        HIST_BUCKETS = (1, 2, 4)  # lint: allow[bucket-drift] histogram bounds, not dispatch shapes
+        """)
+    assert found == []
+
+
+def test_vl103_canonical_grid_must_match_policy_pin(tmp_path):
+    """The perf model's own declaration is checked against the lint
+    policy pin: a grid change must be a conscious two-file edit."""
+    found = _lint_file(tmp_path, "vearch_tpu/ops/perf_model.py", """\
+        ROW_BUCKETS = (8, 64, 256, 2048)
+        FETCH_K_TIERS = (16, 64, 256, 1024)
+        """)
+    assert _rules(found) == ["VL103"]
+    assert "policy" in found[0].message
+    # matching grids are clean
+    found = _lint_file(tmp_path, "vearch_tpu/ops/perf_model.py", """\
+        ROW_BUCKETS = (8, 64, 256, 1024)
+        FETCH_K_TIERS = (16, 64, 256, 1024)
+        """)
+    assert found == []
+    # a missing declaration is as bad as a drifted one
+    found = _lint_file(tmp_path, "vearch_tpu/ops/perf_model.py", """\
+        ROW_BUCKETS = (8, 64, 256, 1024)
+        """)
+    assert _rules(found) == ["VL103"]
+    assert "FETCH_K_TIERS" in found[0].message
 
 
 def test_vl201_unguarded_mutation_fires(tmp_path):

@@ -152,6 +152,68 @@ def test_mesh_probe_gate_recall(rng):
     assert overlap >= 0.7, overlap
 
 
+# -- query-axis parallelism (ISSUE 16) ---------------------------------------
+
+
+def test_mesh_query_axis_bit_identical_to_data_only(rng):
+    """query_axis=2 serves the IVF path bit-identical to the data×1
+    mesh: each query row's scan/rerank math is untouched by which
+    query-shard computes it, and the data-axis merge is an exact top-k
+    over exact scores — so changing EITHER axis must not move a bit."""
+    _, mesh, _ = _ivfpq_pair(rng)
+    base = {"scan_mode": "full"}
+    for rows in (8, 3):  # 3 exercises query-axis padding (3 -> 4)
+        q = rng.standard_normal((rows, D)).astype(np.float32)
+        ss, si = mesh.search(q, 10, None, base)  # default data×1 (8x1)
+        for shape in ("4x2", "4x1"):
+            ms, mi = mesh.search(q, 10, None, dict(base, mesh_shape=shape))
+            assert np.array_equal(si, mi), shape
+            assert np.array_equal(ss, ms), shape
+        # shrinking the data axis further (2x4) reshapes the gathered-
+        # candidate rerank gemm — same ids, low-f32-bit score drift.
+        # The guarantee under test is query-axis invariance, not
+        # arbitrary re-sharding of the data axis.
+        ms, mi = mesh.search(q, 10, None, dict(base, mesh_shape="2x4"))
+        assert np.array_equal(si, mi)
+        assert np.allclose(ss, ms, rtol=1e-5)
+
+
+def test_mesh_shape_knob_single_parse_point():
+    """Every spelling of the knob lands on the same cached Mesh object
+    (shard_map program caches key on mesh identity)."""
+    assert mesh_lib.mesh_from_shape("4x2") is \
+        mesh_lib.make_mesh(8, data_axis=4, query_axis=2)
+    assert mesh_lib.mesh_from_shape((4, 2)) is mesh_lib.mesh_from_shape("4x2")
+    assert mesh_lib.mesh_from_shape(8) is mesh_lib.default_mesh()
+    for alias in (None, "", "auto", "default"):
+        assert mesh_lib.mesh_from_shape(alias) is mesh_lib.default_mesh()
+    m = mesh_lib.mesh_from_shape("2x4")
+    assert (m.shape["data"], m.shape["query"]) == (2, 4)
+
+
+def test_mesh_query_axis_engine_apply_config(rng):
+    """apply_config({"mesh_shape": ...}) fans the knob into live index
+    params: the next search re-places onto the new mesh and stays
+    bit-identical."""
+    eng, vecs = _build("IVFPQ", dict(MESH_PARAMS), n=1200)
+    req = {"scan_mode": "full"}
+    ledger = _search(eng, vecs, index_params=req)
+    assert ledger.tags == perf_model.DOCUMENTED_DISPATCHES["ivfpq_mesh_fused"]
+    res0 = eng.search(SearchRequest(
+        vectors={"emb": vecs[:8]}, k=10, include_fields=[],
+        index_params=req))
+    eng.apply_config({"mesh_shape": "4x2"})
+    idx = eng.indexes["emb"]
+    assert idx._serving_mesh(None).shape["query"] == 2
+    res1 = eng.search(SearchRequest(
+        vectors={"emb": vecs[:8]}, k=10, include_fields=[],
+        index_params=req))
+    for r0, r1 in zip(res0, res1):
+        assert [(i.key, i.score) for i in r0.items] == \
+            [(i.key, i.score) for i in r1.items]
+    eng.close()
+
+
 # -- dispatch ledger + compiled-program gates --------------------------------
 
 
@@ -172,6 +234,71 @@ def test_mesh_paths_launch_documented_dispatches(mesh_engine):
         assert ledger.tags == doc[path], (
             f"{path}: launched {ledger.tags}, documented {doc[path]}"
         )
+
+
+def test_mesh_probe_regime_documented_dispatch(mesh_engine):
+    """scan_mode=probe on a mesh partition keeps the row-sharded layout:
+    one fused program gated to the probed cells, its own dispatch tag —
+    it must NOT fall back to the single-device bucket scan."""
+    eng, vecs = mesh_engine
+    ledger = _search(eng, vecs,
+                     index_params={"scan_mode": "probe", "nprobe": 8})
+    assert ledger.tags == \
+        perf_model.DOCUMENTED_DISPATCHES["ivfpq_mesh_probe"], ledger.tags
+
+
+def test_mesh_probe_regime_recall(rng):
+    """The probe regime under the mesh prunes to nprobe cells — recall
+    against the ungated mesh scan stays high at moderate nprobe, and
+    query-axis sharding doesn't change what the gate admits."""
+    _, mesh, _ = _ivfpq_pair(rng)
+    q = rng.standard_normal((8, D)).astype(np.float32)
+    _, full_i = mesh.search(q, 10, None, {"scan_mode": "full"})
+    _, probe_i = mesh.search(
+        q, 10, None, {"scan_mode": "probe", "nprobe": 8})
+    overlap = np.mean([
+        len(set(full_i[r]) & set(probe_i[r])) / 10
+        for r in range(q.shape[0])
+    ])
+    assert overlap >= 0.7, overlap
+    _, probe_qa = mesh.search(
+        q, 10, None,
+        {"scan_mode": "probe", "nprobe": 8, "mesh_shape": "4x2"})
+    assert np.array_equal(probe_i, probe_qa)
+
+
+def test_mesh_full_scan_cliff_scales_with_data_axis(rng):
+    """The auto full->probe cliff is a per-chip row budget: it scales by
+    the DATA axis of the serving mesh, not the device count. Same index,
+    same 8 devices — a 2x4 mesh holds 4x the rows per chip of an 8x1
+    mesh, so its cliff sits at a quarter the total row count."""
+    data = rng.standard_normal((N, D)).astype(np.float32)
+    store = RawVectorStore(D)
+    store.add(data)
+    idx = IVFPQIndex(IndexParams("IVFPQ", MetricType.L2, {
+        "ncentroids": 16, "nsubvector": 8, "train_iters": 4,
+        "mesh_serving": "on", "full_scan_limit": 500,
+    }), store)
+    idx.train(data[:2000])
+    idx.absorb(N)
+    q = rng.standard_normal((4, D)).astype(np.float32)
+
+    def route(params):
+        ledger: list = []
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            idx.search(q, 10, None, params)
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        return ledger
+
+    # 8x1: budget 500*8 = 4000 >= 3000 rows -> stays in the full scan
+    assert route({}) == \
+        perf_model.DOCUMENTED_DISPATCHES["ivfpq_mesh_fused"]
+    # 2x4: still 8 devices, but budget 500*2 = 1000 < 3000 -> probe
+    # regime (counting all devices would wrongly keep this on full)
+    assert route({"mesh_shape": "2x4"}) == \
+        perf_model.DOCUMENTED_DISPATCHES["ivfpq_mesh_probe"]
 
 
 def test_mesh_scan_only_path_scann_reordering_off(rng):

@@ -189,10 +189,12 @@ def test_novel_shape_request_records_one_event_with_trace_id(cluster):
     cl, vecs = _seed_space(cluster)
     _warm(cluster, cl, vecs)
 
-    # limit is a static arg of the top-k program: an unseen value
-    # forces XLA to compile a new specialisation on the serving path
+    # limit is a static arg of the top-k program, but the scheduler
+    # quantizes it to a fetch-k tier (PERF.md Tier 7) — warmup's
+    # limit=3 already compiled the 16-tier, so only a limit in an
+    # UNSEEN tier (17 -> 64) forces a new specialisation
     out = rpc.call(cluster.router_addr, "POST", "/document/search", {
-        "db_name": "db", "space_name": "s", "limit": 7, "trace": True,
+        "db_name": "db", "space_name": "s", "limit": 17, "trace": True,
         "vectors": [{"field": "v", "feature": vecs[9].tolist()}],
     })
     assert out.get("trace_id")
@@ -201,9 +203,10 @@ def test_novel_shape_request_records_one_event_with_trace_id(cluster):
     assert comp["total"] == 1, comp
     assert len(comp["events"]) == 1
     ev = comp["events"][0]
-    # the event names the program, the shape cause, and the request
+    # the event names the program, the shape cause (the padded tier k,
+    # not the caller's 17), and the request
     assert ev["path"] == "distance.brute_force_search"
-    assert "|7|" in ev["shapes"], ev["shapes"]
+    assert "|64|" in ev["shapes"], ev["shapes"]
     assert ev["trace_id"] == out["trace_id"]
     assert ev["elapsed_ms"] > 0
 
@@ -212,11 +215,13 @@ def test_novel_shape_request_records_one_event_with_trace_id(cluster):
     assert gauge_value(text, "vearch_serving_compiles_total",
                        path="distance.brute_force_search") == 1.0
 
-    # a REPEAT of the now-compiled shape adds nothing (dedupe + jit hit)
-    rpc.call(cluster.router_addr, "POST", "/document/search", {
-        "db_name": "db", "space_name": "s", "limit": 7,
-        "vectors": [{"field": "v", "feature": vecs[9].tolist()}],
-    })
+    # a REPEAT adds nothing (dedupe + jit hit) — and so does any OTHER
+    # limit in the same tier: shape buckets make 20 the same program
+    for lim in (17, 20):
+        rpc.call(cluster.router_addr, "POST", "/document/search", {
+            "db_name": "db", "space_name": "s", "limit": lim,
+            "vectors": [{"field": "v", "feature": vecs[9].tolist()}],
+        })
     comp = rpc.call(cluster.ps_nodes[0].addr, "GET", "/debug/compiles")
     assert comp["total"] == 1
 
@@ -326,9 +331,14 @@ def test_doctor_green_on_healthy_cluster_then_flags_violation(cluster):
     summary = doctor.format_report(report)
     assert "all checks passed" in summary
 
-    # inject a violation: force a post-warmup serving compile
+    # inject a violation: force a post-warmup serving compile — the
+    # limit must land in a fetch-k tier no test in this process has
+    # touched (100 -> tier 256; a same-tier limit like 11 rides the
+    # warmed program and compiles nothing, which is the scheduler
+    # working as designed — the 64-tier is already burned by the
+    # novel-shape test sharing this jit cache)
     rpc.call(cluster.router_addr, "POST", "/document/search", {
-        "db_name": "db", "space_name": "s", "limit": 11,
+        "db_name": "db", "space_name": "s", "limit": 100,
         "vectors": [{"field": "v", "feature": vecs[2].tolist()}],
     })
     report, code = doctor.run(cluster.master_addr)

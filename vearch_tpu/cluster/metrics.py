@@ -23,7 +23,7 @@ _DEFAULT_BUCKETS = (
 # power-of-two buckets for count/size-shaped histograms (WAL batch
 # entries, docs per write) where the latency-shaped defaults would put
 # every sample in +Inf
-SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)  # lint: allow[bucket-drift] histogram boundaries, not device batch shapes
 
 
 def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:

@@ -728,6 +728,56 @@ class PSServer:
         m.callback_gauge("vearch_ps_inflight",
                          "requests currently executing, per op",
                          ("op",), _inflight_ops)
+
+        # continuous-batching scheduler: fixed event universe, node-
+        # level sums across hosted engines — zero-filled every scrape so
+        # the cardinality soak sees no series growth as traffic mixes
+        def _sched_events():
+            out = {(e,): 0.0 for e in
+                   ("batch", "batched_request", "full_dispatch",
+                    "age_timeout")}
+            for eng in list(self.engines.values()):
+                mb = eng._microbatcher
+                if mb is None:
+                    continue
+                out[("batch",)] += float(mb.batches)
+                out[("batched_request",)] += float(mb.batched_requests)
+                out[("full_dispatch",)] += float(mb.full_dispatches)
+                out[("age_timeout",)] += float(mb.age_timeout_fires)
+            return out
+
+        def _pad_waste_bytes():
+            total = 0
+            for eng in list(self.engines.values()):
+                total += int(getattr(eng, "pad_waste_bytes", 0))
+            return {(): float(total)}
+
+        def _bucket_occupancy():
+            rows = cap = 0
+            for eng in list(self.engines.values()):
+                mb = eng._microbatcher
+                if mb is None:
+                    continue
+                rows += mb.dispatch_rows
+                cap += mb.dispatch_capacity
+            return {(): round(100.0 * rows / max(cap, 1), 2)}
+
+        m.callback_counter("vearch_ps_batch_sched_events_total",
+                           "continuous-batching scheduler events: "
+                           "multi-request dispatches (batch), requests "
+                           "that shared one (batched_request), buckets "
+                           "dispatched full vs on age-bound expiry",
+                           ("event",), _sched_events)
+        m.callback_counter("vearch_ps_batch_padding_waste_bytes",
+                           "bytes of padding rows added to reach the "
+                           "declared shape buckets, summed across "
+                           "hosted engines",
+                           (), _pad_waste_bytes)
+        m.callback_gauge("vearch_ps_batch_occupancy_pct",
+                         "real rows as a share of padded bucket "
+                         "capacity across all scheduler dispatches "
+                         "(100 = perfectly packed)",
+                         (), _bucket_occupancy)
         register_tracer_metrics(m, self.tracer)
 
     # -- lifecycle -----------------------------------------------------------
@@ -2069,9 +2119,14 @@ class PSServer:
             if job is not None:
                 op = str(job.get("op", "build"))
                 for name, start_us, dur_us in job.get("_phase_spans") or []:
+                    tags = {"partition": pid, "op": op}
+                    if name == "build.train" and job.get("train_mesh"):
+                        # mesh-sharded k-means ran: record the build-time
+                        # mesh shape so traces tell sharded trains from
+                        # single-device ones
+                        tags["train_mesh"] = str(job["train_mesh"])
                     self.tracer.record(
-                        name, start_us=start_us, dur_us=dur_us,
-                        tags={"partition": pid, "op": op},
+                        name, start_us=start_us, dur_us=dur_us, tags=tags,
                     )
 
     def _h_jobs(self, _body, _parts) -> dict:
@@ -2831,6 +2886,10 @@ class PSServer:
                     "micro_batched_requests": (
                         mb.batched_requests if mb is not None else 0
                     ),
+                    # continuous-batching scheduler: bucket occupancy,
+                    # dispatch mix, padding waste — the doctor's
+                    # batch_padding_waste check reads this block
+                    "scheduler": self._scheduler_info_safe(eng),
                     "raft": self.raft_nodes[pid].state()
                     if pid in self.raft_nodes else None,
                     "mesh": self._mesh_info_safe(eng),
@@ -2854,6 +2913,25 @@ class PSServer:
     def _tiering_info_safe(eng) -> dict | None:
         try:
             return eng.tiering_info()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _scheduler_info_safe(eng) -> dict | None:
+        try:
+            mb = eng._microbatcher
+            if mb is None:
+                return None
+            info = mb.stats()
+            real = int(getattr(eng, "pad_real_rows", 0))
+            padded = int(getattr(eng, "pad_padded_rows", 0))
+            info["pad_real_rows"] = real
+            info["pad_padded_rows"] = padded
+            info["pad_waste_bytes"] = int(getattr(eng, "pad_waste_bytes", 0))
+            info["padding_waste_pct"] = round(
+                100.0 * max(padded - real, 0) / max(padded, 1), 2
+            )
+            return info
         except Exception:
             return None
 

@@ -182,11 +182,25 @@ class Engine:
         # callers join an identical in-flight build, and gates publish on
         # the marker still being current (supersede/remove cancels it)
         self._field_builds: dict[str, _FieldBuild] = {}
-        # query micro-batching (engine/microbatch.py): lazily started on
+        # continuous batching (engine/batching.py): lazily started on
         # the first qualifying search so idle engines spawn no thread
         self.micro_batch = True
         self.micro_batch_max_rows = 1024
+        # age bound on a partially-filled shape bucket (ms); 0 = dispatch
+        # the moment the dispatcher is free (zero added idle latency)
+        self.batch_delay_ms = 0.0
         self._microbatcher = None
+        # padded shape buckets (ops/perf_model.ROW_BUCKETS /
+        # FETCH_K_TIERS): every serving dispatch is quantized to the
+        # declared grid so the warmed program set is finite and
+        # mixed-k traffic co-batches. Off reverts to free-form shapes
+        # (the pre-bucket baseline, kept for A/B).
+        self.shape_buckets = True
+        # padding-waste accounting (best-effort counters; the doctor
+        # flags sustained waste, /ps/stats surfaces them)
+        self.pad_real_rows = 0
+        self.pad_padded_rows = 0
+        self.pad_waste_bytes = 0
         self._scalar_manager = None
         if schema.composite_indexes or any(
             f.scalar_index.value != "NONE" for f in schema.scalar_fields()
@@ -601,6 +615,24 @@ class Engine:
             mb = self._microbatcher
             if mb is not None:  # propagate to a live batcher
                 mb.max_rows = self.micro_batch_max_rows
+        if "batch_delay_ms" in cfg:
+            self.batch_delay_ms = float(cfg["batch_delay_ms"])
+            mb = self._microbatcher
+            if mb is not None:  # propagate to a live scheduler
+                mb.max_delay_ms = self.batch_delay_ms
+        if "shape_buckets" in cfg:
+            # A/B escape hatch: free-form dispatch shapes (the
+            # pre-bucket baseline). The scheduler reads this per submit,
+            # so flipping it also reverts co-batching to exact-k keys.
+            self.shape_buckets = bool(cfg["shape_buckets"])
+        if "mesh_shape" in cfg:
+            # serving-mesh shape ("DxQ", [data, query], or device
+            # count): fans into every vector field's index params, same
+            # pattern as mesh_serving; parallel/mesh.mesh_from_shape
+            # resolves it to one cached Mesh so the program caches and
+            # the sharded row caches key consistently
+            for index in self.indexes.values():
+                index.params.params["mesh_shape"] = cfg["mesh_shape"]
         if "mesh_serving" in cfg:
             # space-level toggle for the multi-chip data plane: fan the
             # mode into every vector field's index params (per-field
@@ -828,6 +860,12 @@ class Engine:
                         t0 = time.monotonic()
                         index.train(store.host_view())
                         mark("train", t0, time.monotonic())
+                        # which mesh trained the coarse quantizer (None
+                        # = single device); the PS replays it as a tag
+                        # on the build.train span
+                        tm = getattr(index, "last_train_mesh", None)
+                        if tm:
+                            job["train_mesh"] = tm
                     t0 = time.monotonic()
                     index.absorb(store.count)
                     mark("assign", t0, time.monotonic())
@@ -909,7 +947,17 @@ class Engine:
             row = np.asarray(store.host_view()[:1], dtype=np.float32)
             valid = self._device_alive_mask(self.table.doc_count)
             kk = max(1, min(int(k), store.count))
-            for b in sorted({int(x) for x in b_list if int(x) > 0}):
+            b_set = {int(x) for x in b_list if int(x) > 0}
+            if self.shape_buckets:
+                # warm the shapes serving will actually dispatch: the
+                # engine quantizes rows and fetch-k to the declared
+                # buckets, so warming the raw sizes would compile
+                # programs no request ever runs
+                from vearch_tpu.ops import perf_model as _perf
+
+                kk = _perf.bucket_fetch_k(kk)
+                b_set = {_perf.bucket_rows(b) for b in b_set}
+            for b in sorted(b_set):
                 q = np.repeat(row, b, axis=0)
                 if index.trained:
                     index.search(q, kk, valid)
@@ -952,9 +1000,10 @@ class Engine:
         return self._mask_cache
 
     def search(self, req: SearchRequest) -> list[SearchResult]:
-        """Search entry: compatible concurrent requests are combined
-        into one device dispatch (engine/microbatch.py); filtered,
-        brute-force, and batching-disabled requests run directly."""
+        """Search entry: compatible concurrent requests pack into padded
+        shape buckets and share one device dispatch
+        (engine/batching.py); filtered, brute-force, and
+        batching-disabled requests run directly."""
         if (
             self.micro_batch
             and req.filters is None
@@ -969,10 +1018,11 @@ class Engine:
                     # re-check micro_batch under the lock: close() flips
                     # it to False before stopping the batcher
                     if mb is None and self.micro_batch:
-                        from vearch_tpu.engine.microbatch import MicroBatcher
+                        from vearch_tpu.engine.batching import BatchScheduler
 
-                        mb = self._microbatcher = MicroBatcher(
-                            self, max_rows=self.micro_batch_max_rows
+                        mb = self._microbatcher = BatchScheduler(
+                            self, max_rows=self.micro_batch_max_rows,
+                            max_delay_ms=self.batch_delay_ms,
                         )
             if mb is not None:
                 return mb.submit(req)
@@ -1057,9 +1107,19 @@ class Engine:
                     f"fields; got {[m.value for m in metrics]}"
                 )
 
+            from vearch_tpu.ops import perf_model as _perf
+
             per_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
             queries_by_field: dict[str, np.ndarray] = {}
             fetch_k = req.k if len(req.vectors) == 1 else max(req.k * 4, 50)
+            if self.shape_buckets:
+                # quantize the candidate depth UP to the declared tier —
+                # uniformly, solo and batched alike, so co-batching
+                # requests of differing k stays bit-identical to solo
+                # runs (both scan at the tier; _shape_results trims each
+                # caller to its own k) and the compiled-program universe
+                # per path is bounded by the declared grid
+                fetch_k = _perf.bucket_fetch_k(fetch_k)
             for name, queries in req.vectors.items():
                 if req.ctx is not None:
                     req.ctx.check()
@@ -1072,6 +1132,25 @@ class Engine:
                     queries.reshape(queries.shape[0], index.input_dim)
                 )
                 queries_by_field[name] = queries
+                b_rows = int(queries.shape[0])
+                q_run = queries
+                if self.shape_buckets:
+                    # pad the row axis up to the declared bucket with a
+                    # REAL row (cosine normalisation of a zero row is
+                    # degenerate); every scan path is per-query-row, so
+                    # slicing the pad rows back off preserves results
+                    bb = _perf.bucket_rows(b_rows)
+                    if bb != b_rows:
+                        q_run = np.concatenate(
+                            [queries,
+                             np.repeat(queries[-1:], bb - b_rows, axis=0)],
+                            axis=0,
+                        )
+                    self.pad_real_rows += b_rows
+                    self.pad_padded_rows += bb
+                    self.pad_waste_bytes += _perf.padding_waste_bytes(
+                        b_rows, bb, int(queries.shape[1])
+                    )
                 store = self.vector_stores[name]
                 use_index = index.trained and not req.brute_force
                 if use_index:
@@ -1080,7 +1159,7 @@ class Engine:
                         # the last pass (reference: AddRTVecsToIndex)
                         index.absorb(store.count)
                     scores, ids = index.search(
-                        queries, fetch_k, valid, req.index_params or None
+                        q_run, fetch_k, valid, req.index_params or None
                     )
                 else:
                     # brute-force fallback below training threshold
@@ -1090,8 +1169,8 @@ class Engine:
                     flat = FlatIndex(
                         IndexParams(metric_type=index.metric), store
                     )
-                    scores, ids = flat.search(queries, fetch_k, valid)
-                per_field[name] = (scores, ids)
+                    scores, ids = flat.search(q_run, fetch_k, valid)
+                per_field[name] = (scores[:b_rows], ids[:b_rows])
                 if tracing:
                     from vearch_tpu.ops import ivf as _ivf_ops
 

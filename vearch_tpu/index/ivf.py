@@ -95,11 +95,57 @@ class _IVFBase(VectorIndex):
             return (x / n).astype(np.float32)
         return x
 
+    #: "DxQ" mesh tag of the last coarse-quantizer training, None for
+    #: the single-device trainer (build jobs and build.train spans
+    #: record it)
+    last_train_mesh: str | None = None
+
+    def _train_mesh(self):
+        """Mesh for coarse-quantizer training, or None for the single-
+        device path. Opt-in (``mesh_train: true``): the sharded
+        trainer's k-means++ init subsamples differently from the
+        single-device trainer, so flipping it on changes the trained
+        centroids — an explicit build-time decision, not an ambient one
+        that would silently shift recall when the device count changes.
+        """
+        if not bool(self.params.get("mesh_train", False)):
+            return None
+        if len(jax.devices()) <= 1:
+            return None
+        return self._serving_mesh(None)
+
+    def _serving_mesh(self, params: dict | None):
+        """The mesh this index places/serves on: the ``mesh_shape`` knob
+        (engine apply_config fans it into index params; per-request
+        override wins), defaulting to the all-devices data×1 mesh."""
+        from vearch_tpu.parallel import mesh as mesh_lib
+
+        shape = (params or {}).get(
+            "mesh_shape", self.params.get("mesh_shape")
+        )
+        return mesh_lib.mesh_from_shape(shape)
+
     def train(self, sample: np.ndarray) -> None:
         x = self._maybe_normalize(self._sample(np.asarray(sample, np.float32)))
-        self.centroids = km.train_kmeans(
-            jnp.asarray(x), k=self.nlist, iters=self.train_iters
-        )
+        mesh = self._train_mesh()
+        if mesh is not None:
+            # multi-chip coarse training: per-shard partial sums, psum
+            # over "data" (parallel/sharded.py train_kmeans_sharded) —
+            # index builds use all chips instead of serializing Lloyd
+            # rounds on one
+            from vearch_tpu.parallel.sharded import train_kmeans_sharded
+
+            self.centroids = train_kmeans_sharded(
+                mesh, x, k=self.nlist, iters=self.train_iters
+            )
+            self.last_train_mesh = (
+                f"{mesh.shape['data']}x{mesh.shape['query']}"
+            )
+        else:
+            self.centroids = km.train_kmeans(
+                jnp.asarray(x), k=self.nlist, iters=self.train_iters
+            )
+            self.last_train_mesh = None
         self._members = [[] for _ in range(self.nlist)]
         self._build_coarse_graph()
         self._train_extra(x)
@@ -591,30 +637,51 @@ class IVFPQIndex(_IVFBase):
         )
         mode = (params or {}).get("scan_mode", self.scan_mode)
         mesh_on = self._mesh_enabled(params)
-        if mode == "auto":
-            # the full-scan budget is per chip: a mesh-spanning
-            # partition scans its rows in parallel, so the cliff to
-            # probe mode scales with the mesh
-            limit = self.full_scan_limit
-            if mesh_on:
-                limit *= max(len(jax.devices()), 1)
-            mode = "full" if self.indexed_count <= limit else "probe"
         from vearch_tpu.index._store_paths import is_disk_store
 
         scan_kernel = (params or {}).get(
             "scan_kernel", self.params.get("scan_kernel", "xla")
         )
-        if (
-            mode == "full" and mesh_on
-            and scan_kernel != "pallas"
+        # mesh mode needs the raw buffer sharded across HBM — a disk
+        # store can't provide that; it falls through to the
+        # single-device scan with host-gathered rerank. The pallas
+        # kernel is likewise a single-device program (hardware A/B
+        # flag), so it keeps the single-device path too.
+        mesh_route = (
+            mesh_on and scan_kernel != "pallas"
             and not is_disk_store(self.store)
-        ):
-            # mesh mode needs the raw buffer sharded across HBM — a
-            # disk store can't provide that; fall through to the
-            # single-device scan with host-gathered rerank. The pallas
-            # kernel is likewise a single-device program (hardware A/B
-            # flag), so it keeps the single-device path too.
+        )
+        if mode == "auto":
+            # the full-scan budget is per chip: a mesh-spanning
+            # partition scans its rows in parallel, so the cliff to
+            # probe mode scales with the DATA axis of the serving mesh
+            # — a query_axis>1 mesh still holds n/data_axis rows per
+            # chip, so counting all devices would move the cliff to the
+            # wrong row count
+            limit = self.full_scan_limit
+            if mesh_route:
+                limit *= max(
+                    int(self._serving_mesh(params).shape["data"]), 1
+                )
+            mode = "full" if self.indexed_count <= limit else "probe"
+        if mesh_route and mode == "full":
             return self._search_mesh(q, k, valid_mask, params, metric)
+        if (
+            mesh_route and mode == "probe"
+            and self._exact_rerank_enabled(params)
+            and (params or {}).get(
+                "fused_rerank", self.params.get("fused_rerank", True)
+            )
+        ):
+            # probe regime under the mesh: keep the row-sharded layout
+            # and gate the ONE fused program to the probed coarse cells
+            # — past the full-scan cliff a mesh partition no longer
+            # falls back to a single chip. (reordering=false and the
+            # unfused A/B path keep the single-device bucket layout.)
+            return self._search_mesh(
+                q, k, valid_mask, params, metric,
+                probe_nprobe=max(self._nprobe(params), 1),
+            )
         if mode == "full":
             approx8, scale, vsq = self._mirror.flush()
             n_pad = approx8.shape[0]
@@ -797,16 +864,23 @@ class IVFPQIndex(_IVFBase):
         return assign
 
     def _search_mesh(
-        self, q: np.ndarray, k: int, valid_mask, params, metric
+        self, q: np.ndarray, k: int, valid_mask, params, metric,
+        probe_nprobe: int = 0,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Mesh-spanning serving path: the int8 mirror, the raw rerank
-        buffer, and the row->cluster assignment are row-sharded over all
-        devices; an optional coarse-probe gate, the compressed scan, the
-        all_gather candidate merge, the exact rerank, and the pmax score
-        merge all run inside ONE jitted shard_map program — no host
-        round trips (reference analogue: none; this is the TPU capacity
-        axis on top of the reference's partition sharding). Placement is
-        incremental: absorb tail-appends only the new rows per shard."""
+        buffer, and the row->cluster assignment are row-sharded over the
+        serving mesh's "data" axis, the query batch shards over its
+        "query" axis; an optional coarse-probe gate, the compressed
+        scan, the all_gather candidate merge, the exact rerank, and the
+        pmax score merge all run inside ONE jitted shard_map program —
+        no host round trips (reference analogue: none; this is the TPU
+        capacity axis on top of the reference's partition sharding).
+        Placement is incremental: absorb tail-appends only the new rows
+        per shard.
+
+        ``probe_nprobe>0`` is the probe REGIME routed here by search():
+        same fused program, gated to the probed cells — distinct
+        dispatch tag so the perf model tells the regimes apart."""
         import time as _time
 
         from vearch_tpu.parallel import mesh as mesh_lib
@@ -817,17 +891,17 @@ class IVFPQIndex(_IVFBase):
         )
 
         t_place0 = _time.monotonic()
-        mesh = mesh_lib.default_mesh()
+        mesh = self._serving_mesh(params)
         a8, scale, vsq = self._mirror.flush_sharded(mesh)
         n = self.indexed_count
         cap = self._mirror._sh_cache.capacity(mesh, n)
         valid_sh = self._mesh_valid_sharded(mesh, valid_mask, n, cap)
-        nprobe = self._mesh_nprobe(params)
+        nprobe = probe_nprobe or self._mesh_nprobe(params)
         cents = assign_sh = None
         if nprobe > 0:
             cents = mesh_lib.replicate(mesh, np.asarray(self.centroids))
             assign_sh = self._assign_sharded(mesh, n)
-        qrep = mesh_lib.replicate(mesh, np.asarray(q, np.float32))
+        qd, b = mesh_lib.shard_queries(mesh, np.asarray(q, np.float32))
         r = min(self._rerank_depth(k, params), max(n, 1))
         topk_mode = (params or {}).get(
             "topk_mode", self.params.get("topk_mode", "auto")
@@ -839,43 +913,44 @@ class IVFPQIndex(_IVFBase):
         if fused and rerank:
             base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
             ivf_ops.note_mesh_phase("place", t_place0, _time.monotonic())
-            ivf_ops.note_dispatch("sharded_fused_scan_rerank")
+            ivf_ops.note_dispatch(
+                "sharded_probe_scan_rerank" if probe_nprobe > 0
+                else "sharded_fused_scan_rerank"
+            )
             scores, ids = sharded_ivf_search(
                 mesh, cents, assign_sh, a8, scale, vsq, valid_sh,
-                base, base_sqn, qrep, max(r, k),
+                base, base_sqn, qd, max(r, k),
                 min(k, max(r, k)),
                 scan_metric=metric, rerank_metric=self.metric,
                 topk_mode=topk_mode, storage=self.mirror_storage,
                 nprobe=nprobe,
             )
             scores, ids = jax.device_get((scores, ids))
-            return self._pad_to_k(scores, ids, k)
+            return self._pad_to_k(scores[:b], ids[:b], k)
         ivf_ops.note_mesh_phase("place", t_place0, _time.monotonic())
         ivf_ops.note_dispatch("sharded_scan")
         cand_s, cand_i = sharded_int8_search(
-            mesh, a8, scale, vsq, valid_sh, qrep, max(r, k), metric,
+            mesh, a8, scale, vsq, valid_sh, qd, max(r, k), metric,
             topk_mode, storage=self.mirror_storage,
         )
         if not rerank:
             scores, ids = jax.device_get((cand_s, cand_i))
-            return self._pad_to_k(scores[:, :k], ids[:, :k], k)
+            return self._pad_to_k(scores[:b, :k], ids[:b, :k], k)
         base, base_sqn, _ = self.store.device_buffer_sharded(mesh)
         ivf_ops.note_dispatch("sharded_rerank")
         scores, ids = sharded_exact_rerank(
-            mesh, qrep.astype(base.dtype), cand_i, base, base_sqn,
+            mesh, qd.astype(base.dtype), cand_i, base, base_sqn,
             min(k, int(cand_i.shape[1])), self.metric,
         )
         scores, ids = jax.device_get((scores, ids))
-        return self._pad_to_k(scores, ids, k)
+        return self._pad_to_k(scores[:b], ids[:b], k)
 
     def mesh_info(self) -> dict[str, Any] | None:
         """Mesh data-plane placement summary (surfaced in /ps/stats and
         profile:true explains); None when mesh serving is off."""
         if not self._mesh_enabled(None):
             return None
-        from vearch_tpu.parallel import mesh as mesh_lib
-
-        mesh = mesh_lib.default_mesh()
+        mesh = self._serving_mesh(None)
         sh = self._mirror._sh_cache
         info: dict[str, Any] = {
             "devices": int(mesh.size),
@@ -898,9 +973,8 @@ class IVFPQIndex(_IVFBase):
         if not self._mesh_enabled(None):
             return self.device_footprint_bytes()
         from vearch_tpu.ops import perf_model
-        from vearch_tpu.parallel import mesh as mesh_lib
 
-        mesh = mesh_lib.default_mesh()
+        mesh = self._serving_mesh(None)
         n_shards = int(mesh.shape["data"])
         sharded = self._mirror.device_bytes() + \
             perf_model.raw_store_footprint_bytes(

@@ -39,9 +39,15 @@ class ShardedFlatIndex(VectorIndex):
 
     def __init__(self, params: IndexParams, store: RawVectorStore):
         super().__init__(params, store)
-        n_dev = int(params.get("n_devices", 0)) or len(jax.devices())
-        query_axis = int(params.get("query_axis", 1))
-        self.mesh = mesh_lib.make_mesh(n_dev, query_axis=query_axis)
+        shape = params.get("mesh_shape")
+        if shape is not None:
+            # unified knob shared with the IVF mesh path (engine
+            # apply_config fans it into index params)
+            self.mesh = mesh_lib.mesh_from_shape(shape)
+        else:
+            n_dev = int(params.get("n_devices", 0)) or len(jax.devices())
+            query_axis = int(params.get("query_axis", 1))
+            self.mesh = mesh_lib.make_mesh(n_dev, query_axis=query_axis)
         self._sh_cache = ShardedRowCache(align=128, sqnorm_of=0)
         self._placed_rows = 0
         self._valid_src = object()  # sentinel: never matches a real mask

@@ -244,6 +244,36 @@ def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
                          else "no disk-tier traffic to judge")),
     })
 
+    # shape-bucket padding waste: a little padding is the price of a
+    # bounded compiled-program universe; sustained waste above 50% of
+    # padded rows means the traffic's natural batch sizes sit just past
+    # tier boundaries — the operator should revisit the bucket grid or
+    # raise batch_delay_ms so buckets fill before dispatch
+    wasteful = []
+    judged = 0
+    for srv in report.get("servers", []):
+        parts = (srv.get("stats") or {}).get("partitions") or {}
+        for pid, part in parts.items():
+            sched = part.get("scheduler") or {}
+            padded = int(sched.get("pad_padded_rows") or 0)
+            if padded < 512:  # not enough traffic to judge
+                continue
+            judged += 1
+            real = int(sched.get("pad_real_rows") or 0)
+            if padded - real > 0.5 * padded:
+                wasteful.append(
+                    f"node {srv.get('node_id')} partition {pid}: "
+                    f"{padded - real}/{padded} padded rows wasted "
+                    f"({sched.get('padding_waste_pct')}%)"
+                )
+    checks.append({
+        "name": "batch_padding_waste", "ok": not wasteful,
+        "detail": ("; ".join(wasteful) if wasteful
+                   else (f"{judged} partition(s) under 50% padding waste"
+                         if judged
+                         else "no bucketed traffic to judge")),
+    })
+
     try:
         ok, detail = _check_obs_docs()
     except Exception as e:

@@ -126,6 +126,10 @@ DOCUMENTED_DISPATCHES: dict[str, list[str]] = {
     "ivfpq_mesh_unfused": ["sharded_scan", "sharded_rerank"],
     # mesh serving with exact rerank disabled: scan+merge only
     "ivfpq_mesh_scan": ["sharded_scan"],
+    # probe regime under the mesh: the fused program gated to the
+    # probed coarse cells (nprobe > 0) — past the full-scan cliff a
+    # mesh partition no longer falls back to one chip
+    "ivfpq_mesh_probe": ["sharded_probe_scan_rerank"],
     # FLAT over the mesh: one fused scan+all_gather+re-top-k program
     "flat_sharded": ["sharded_flat_scan"],
 }
@@ -141,6 +145,77 @@ def path_for_dispatches(tags: list[str]) -> str | None:
         if seq == doc:
             return path
     return None
+
+
+# -- padded shape buckets ----------------------------------------------------
+#
+# Every distinct (rows, k) pair handed to a jitted search program is a
+# separate XLA specialisation: rows changes the traced shape, k is a
+# static arg. Free-form traffic therefore compiles an unbounded program
+# set and co-batching is limited to exact-(k) matches. The serving path
+# instead quantizes BOTH axes to a small declared grid:
+#
+#   rows    padded up to the next ROW_BUCKETS tier (results sliced
+#           back to the caller's row count host-side),
+#   fetch-k padded up to the next FETCH_K_TIERS tier (the engine's
+#           _shape_results already trims each caller to its own k).
+#
+# The compiled-program universe per scan path is then at most
+# len(ROW_BUCKETS) * len(FETCH_K_TIERS) — warmable in full, which is
+# what makes the zero-retrace perf gate assertable — and requests with
+# differing k become co-batchable because every member scans at the
+# bucket's tier and slices to its own depth on the host. vearch-lint
+# VL103 pins serving code to these constants (this module is the single
+# source of truth); tests/test_perf_gates.py asserts the dispatch bound.
+
+#: declared row tiers for batched serving dispatches
+ROW_BUCKETS: tuple[int, ...] = (8, 64, 256, 1024)
+#: declared fetch-k tiers (candidate depth handed to the index)
+FETCH_K_TIERS: tuple[int, ...] = (16, 64, 256, 1024)
+
+
+def bucket_rows(b: int) -> int:
+    """Smallest declared row tier holding `b` rows. Above the top tier
+    returns `b` unchanged — a caller-supplied mega-batch is already one
+    dispatch and padding it further would only waste HBM."""
+    for t in ROW_BUCKETS:
+        if b <= t:
+            return t
+    return int(b)
+
+
+def bucket_fetch_k(k: int) -> int:
+    """Smallest declared fetch-k tier covering depth `k`; above the top
+    tier returns `k` unchanged (out-of-bucket, documented as such)."""
+    for t in FETCH_K_TIERS:
+        if k <= t:
+            return t
+    return int(k)
+
+
+def bucket_program_bound(row_tiers: int | None = None,
+                         k_tiers: int | None = None) -> int:
+    """Upper bound on compiled specialisations per scan path once both
+    axes are quantized: the full declared grid."""
+    r = len(ROW_BUCKETS) if row_tiers is None else int(row_tiers)
+    k = len(FETCH_K_TIERS) if k_tiers is None else int(k_tiers)
+    return r * k
+
+
+def bucket_dispatch_bound(n_requests: int, bucket_capacity: int) -> int:
+    """Max device dispatches a continuous-batching scheduler may issue
+    for `n_requests` single-row requests sharing one bucket key:
+    ceil(requests / capacity). The perf gate asserts the live ledger
+    against this."""
+    return -(-int(n_requests) // max(int(bucket_capacity), 1))
+
+
+def padding_waste_bytes(real_rows: int, padded_rows: int, d: int,
+                        itemsize: int = F32) -> int:
+    """Query bytes a padded dispatch moves for nobody: the pad rows of
+    the [padded_rows, d] query block. The scheduler accumulates this per
+    dispatch; the doctor flags sustained waste > 50%."""
+    return max(int(padded_rows) - int(real_rows), 0) * int(d) * int(itemsize)
 
 
 # -- 2. compiled-program tracking -------------------------------------------

@@ -61,6 +61,29 @@ def make_mesh(
     return _mesh_cached(n, data_axis, query_axis)
 
 
+def mesh_from_shape(shape) -> Mesh:
+    """Resolve a user-facing ``mesh_shape`` knob to a cached mesh.
+
+    Accepts ``"4x2"`` strings, ``(data, query)`` pairs, a bare device
+    count (all on "data"), or None/"" for :func:`default_mesh`. This is
+    the single parse point for the engine/apply_config and index-params
+    surfaces, so every layer lands on the SAME cached Mesh object and
+    the shard_map program caches (keyed on mesh identity) stay warm.
+    """
+    if shape in (None, "", "auto", "default"):
+        return default_mesh()
+    if isinstance(shape, str):
+        parts = shape.lower().split("x")
+        if len(parts) == 1:
+            return make_mesh(int(parts[0]))
+        da, qa = (int(p) for p in parts[:2])
+        return make_mesh(da * qa, data_axis=da, query_axis=qa)
+    if isinstance(shape, (list, tuple)):
+        da, qa = int(shape[0]), int(shape[1])
+        return make_mesh(da * qa, data_axis=da, query_axis=qa)
+    return make_mesh(int(shape))
+
+
 def shard_rows(mesh: Mesh, x, pad_value=0):
     """Place a host [N, ...] array row-sharded over the "data" axis,
     padding N up to a multiple of the axis size. Returns (device_array,
@@ -147,6 +170,11 @@ class ShardedRowCache:
 
     `stats` counts rebuilds / appends / H2D bytes so the perf gates can
     assert absorb never re-places the full buffer.
+
+    The cache is keyed on mesh IDENTITY, so a runtime ``mesh_shape``
+    change (engine apply_config -> index params -> mesh_from_shape)
+    re-places every buffer onto the new mesh on the next get() with no
+    explicit invalidation — the old mesh's placement is simply dropped.
     """
 
     def __init__(self, align: int, sqnorm_of: int | None = None):

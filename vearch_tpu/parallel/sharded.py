@@ -138,8 +138,8 @@ def _int8_search_fn(mesh: Mesh, r: int, metric: MetricType,
 
 def sharded_exact_rerank(
     mesh: Mesh,
-    queries: jax.Array,     # [B, d] replicated
-    cand_ids: jax.Array,    # [B, r] i32 global docids, replicated
+    queries: jax.Array,     # [B_pad, d] sharded P("query", None)
+    cand_ids: jax.Array,    # [B_pad, r] i32 global docids, P("query", None)
     base: jax.Array,        # [N_pad, d] sharded P("data", None)
     base_sqnorm: jax.Array,  # [N_pad] sharded P("data")
     k: int,
@@ -148,7 +148,10 @@ def sharded_exact_rerank(
     """Exact re-scoring against a row-sharded raw buffer: every shard
     scores the candidates it owns (others -inf), pmax over "data" merges
     without leaving the device, then one small top-k. The mesh analogue
-    of ops/ivf.py exact_rerank."""
+    of ops/ivf.py exact_rerank. Every step is per-query-row, so the
+    query batch shards over "query" (positional PartitionSpecs — the
+    program stays mesh-shape agnostic; a 1-wide query axis degenerates
+    to the replicated layout)."""
     return _exact_rerank_fn(mesh, k, metric)(
         queries, cand_ids, base, base_sqnorm
     )
@@ -160,8 +163,10 @@ def _exact_rerank_fn(mesh: Mesh, k: int, metric: MetricType):
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(None, None), P(None, None), P("data", None), P("data")),
-        out_specs=(P(None, None), P(None, None)),
+        in_specs=(
+            P("query", None), P("query", None), P("data", None), P("data"),
+        ),
+        out_specs=(P("query", None), P("query", None)),
         check_rep=False,
     )
     def run(q, cids, b, sqn):
@@ -208,7 +213,7 @@ def sharded_ivf_search(
     valid: jax.Array,             # [N_pad] bool sharded P("data")
     base: jax.Array,              # [cap, d] raw rows sharded P("data", None)
     base_sqnorm: jax.Array,       # [cap] f32 sharded P("data")
-    queries: jax.Array,           # [B, d] f32 replicated
+    queries: jax.Array,           # [B_pad, d] f32 sharded P("query", None)
     r: int,
     k: int,
     scan_metric: MetricType = MetricType.L2,
@@ -244,8 +249,13 @@ def _ivf_search_fn(
     from vearch_tpu.ops.ivf import _coarse_probes, _select_topk, unpack_int4
 
     probed = nprobe > 0
+    # queries ride the "query" axis (last in_spec / both out_specs) —
+    # every stage of the program is per-query-row except the "data"
+    # collectives, so a query_axis>1 mesh splits the batch across its
+    # query shards for free; centroids stay replicated (every query
+    # shard recomputes its own probes, same as every data shard does)
     mirror_specs = (P("data", None), P("data"), P("data"), P("data"))
-    rerank_specs = (P("data", None), P("data"), P(None, None))
+    rerank_specs = (P("data", None), P("data"), P("query", None))
     if probed:
         in_specs = (P(None, None), P("data")) + mirror_specs + rerank_specs
     else:
@@ -256,7 +266,7 @@ def _ivf_search_fn(
         shard_map,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(None, None), P(None, None)),
+        out_specs=(P("query", None), P("query", None)),
         check_rep=False,
     )
     def run(*args):

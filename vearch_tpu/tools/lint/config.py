@@ -68,6 +68,19 @@ LOG_CALL_NAMES = {
 }
 ERROR_COUNT_CALLS = {"internal_error", "inc"}
 
+# -- VL103 shape-bucket drift -------------------------------------------------
+# The continuous-batching scheduler only stays zero-retrace if every
+# serving-path batch shape comes from ONE declared grid. The canonical
+# declaration lives in BUCKET_DECL_FILE; lint pins its values here so
+# the grid cannot change without a conscious policy edit, and flags any
+# OTHER module re-declaring bucket/tier literals instead of importing
+# the perf model's.
+BUCKET_DECL_FILE = "vearch_tpu/ops/perf_model.py"
+BUCKET_ROW_TIERS = (8, 64, 256, 1024)
+BUCKET_FETCH_K_TIERS = (16, 64, 256, 1024)
+# module-level names matched (by suffix) as shape-tier declarations
+BUCKET_NAME_SUFFIXES = ("_BUCKETS", "_TIERS")
+
 # -- VL201 lock discipline ----------------------------------------------------
 # Methods treated as mutations when called on a guarded attribute.
 MUTATOR_METHODS = {
