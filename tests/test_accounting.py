@@ -1,0 +1,508 @@
+"""Per-tenant cost accounting + SLO burn-rate layer (docs/ACCOUNTING.md).
+
+Acceptance properties, each against real machinery:
+
+1. CONSERVATION — in a mixed two-space workload the per-space meters
+   reconcile EXACTLY with the global ledgers: dispatch counts against
+   the dispatch ledger, H2D bytes against the process byte accumulator,
+   and sum(spaces) == totals for every meter at every snapshot. Cache
+   hits bill to the hitting space at zero device cost; a shed 429
+   bills a `sheds` count with no device work; a hedge-marked duplicate
+   attempt bills `hedge_extras`, never a second logical request.
+2. APPORTIONMENT — co-batched shape buckets split measured device time
+   by row share in integer microseconds that sum to the bucket total
+   exactly, across the scheduler's thread hop.
+3. FREE ON THE SERVING PATH — metering adds zero dispatches and zero
+   compiled programs to warmed paths.
+4. SLO BURN — a space with a declared objective that every request
+   violates reaches fast-burn: visible on /router/stats, the burn
+   gauge, /cluster/health (yellow + named space), /cluster/usage, and
+   the doctor exits 1 naming the `slo_burn` violation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.obs import accounting
+from vearch_tpu.obs.accounting import ACCOUNTANT, METERS, SpaceAccountant
+from vearch_tpu.ops import ivf as ivf_ops
+from vearch_tpu.ops import perf_model
+from vearch_tpu.sdk.client import VearchClient
+
+D = 8
+
+
+def _scrape(addr: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def _poll(cond, timeout_s: float, interval_s: float = 0.2):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if cond():
+            return True
+        if time.monotonic() >= deadline:
+            return cond()
+        time.sleep(interval_s)
+
+
+def _meter(snap: dict, space: str, meter: str) -> int:
+    return snap["spaces"].get(space, {}).get(meter, 0)
+
+
+def _delta(before: dict, after: dict, space: str, meter: str) -> int:
+    return _meter(after, space, meter) - _meter(before, space, meter)
+
+
+def _assert_conserved(snap: dict) -> None:
+    """The accounting invariant: every meter's per-space sum equals its
+    global total exactly — nothing uncharged, nothing double-charged."""
+    for meter in METERS:
+        total = snap["totals"][meter]
+        by_space = sum(m[meter] for m in snap["spaces"].values())
+        assert by_space == total, (
+            f"{meter}: sum(spaces)={by_space} != total={total}")
+
+
+def _mk_space(cl: VearchClient, rng, name: str, docs: int = 40,
+              slo: dict | None = None) -> np.ndarray:
+    spec = {
+        "name": name, "partition_num": 1, "replica_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    }
+    if slo is not None:
+        spec["slo"] = slo
+    cl.create_space("db", spec)
+    vecs = rng.standard_normal((docs, D)).astype(np.float32)
+    cl.upsert("db", name, [{"_id": f"d{i}", "v": vecs[i]}
+                           for i in range(docs)])
+    return vecs
+
+
+def _search(router_addr: str, rng, space: str, **extra) -> dict:
+    q = rng.standard_normal(D).astype(np.float32)
+    return rpc.call(router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": space,
+        "vectors": [{"field": "v", "feature": q.tolist()}],
+        "limit": 3, "cache": False, **extra,
+    })
+
+
+def _pid_of(cl: VearchClient, space: str) -> int:
+    return cl.get_space("db", space)["partitions"][0]["id"]
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = StandaloneCluster(data_dir=str(tmp_path / "acct"), n_ps=1,
+                          ps_kwargs={"heartbeat_interval": 0.3})
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(11)
+
+
+# -- 1. conservation ----------------------------------------------------------
+
+
+def test_two_space_workload_reconciles_with_global_ledgers(cluster, rng):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    _mk_space(cl, rng, "a")
+    vecs_b = _mk_space(cl, rng, "b")
+    ps = cluster.ps_nodes[0]
+    # the PS wired itself to the process-global accountant (one per
+    # process, like the ledgers it mirrors)
+    acct = ps._accountant
+    assert acct is ACCOUNTANT
+
+    snap0 = acct.snapshot()
+    h2d0 = perf_model.h2d_bytes_total()
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        for _ in range(10):
+            _search(cluster.router_addr, rng, "a")
+        for _ in range(5):
+            _search(cluster.router_addr, rng, "b")
+        # a write inside the window: ingest H2D bytes bill to the space
+        cl.upsert("db", "b", [{"_id": "w0", "v": vecs_b[0]}])
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    snap1 = acct.snapshot()
+
+    _assert_conserved(snap1)
+    assert _delta(snap0, snap1, "db/a", "requests") == 10
+    assert _delta(snap0, snap1, "db/b", "requests") == 5
+    assert _delta(snap0, snap1, "db/a", "device_us") > 0
+    assert _delta(snap0, snap1, "db/b", "device_us") > 0
+    assert _delta(snap0, snap1, "db/a", "rows") == 10
+
+    # dispatch counts reconcile with the dispatch ledger EXACTLY: the
+    # observer fires inside the same note_dispatch call
+    disp = snap1["totals"]["dispatches"] - snap0["totals"]["dispatches"]
+    assert disp == ledger.dispatch_count()
+    assert disp > 0
+    # ... and H2D bytes with the process byte accumulator
+    h2d = snap1["totals"]["h2d_bytes"] - snap0["totals"]["h2d_bytes"]
+    assert h2d == perf_model.h2d_bytes_total() - h2d0
+    assert h2d > 0, "the write upload must have metered H2D bytes"
+    assert _delta(snap0, snap1, "db/b", "h2d_bytes") > 0
+
+    # the per-space figures ride /ps/stats verbatim
+    stats = rpc.call(ps.addr, "GET", "/ps/stats")
+    usage = stats["usage"]
+    assert usage["scope_id"] == acct.scope_id
+    assert usage["spaces"]["db/a"]["requests"] >= 10
+    assert usage["hbm_bytes"].get("db/a", 0) > 0
+    # per-space HBM residency sums to the node's device footprint
+    page = _scrape(ps.addr)
+    assert 'vearch_space_hbm_bytes{space="db/a"}' in page
+    assert 'vearch_space_requests_total{space="db/a"}' in page
+
+
+def test_cache_hit_bills_space_at_zero_device_cost(cluster, rng):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    vecs = _mk_space(cl, rng, "s")
+    ps = cluster.ps_nodes[0]
+    pid = _pid_of(cl, "s")
+    body = {"partition_id": pid, "vectors": {"v": [vecs[3].tolist()]},
+            "k": 3}
+    # first call computes (device cost) and populates the result cache
+    rpc.call(ps.addr, "POST", "/ps/doc/search", body)
+    snap0 = ps._accountant.snapshot()
+    # the identical repeat is a cache hit: a logical request and a
+    # cache_hits count, but NOT a microsecond of device time
+    rpc.call(ps.addr, "POST", "/ps/doc/search", body)
+    snap1 = ps._accountant.snapshot()
+    assert _delta(snap0, snap1, "db/s", "requests") == 1
+    assert _delta(snap0, snap1, "db/s", "cache_hits") == 1
+    assert _delta(snap0, snap1, "db/s", "device_us") == 0
+    assert _delta(snap0, snap1, "db/s", "dispatches") == 0
+    _assert_conserved(snap1)
+
+
+def test_hedge_marked_attempt_bills_once(cluster, rng):
+    """The router marks its duplicate hedge attempt with _hedge_extra;
+    the PS bills it under `hedge_extras` so a won hedge never counts as
+    two logical requests (its device work still bills honestly)."""
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    vecs = _mk_space(cl, rng, "s")
+    ps = cluster.ps_nodes[0]
+    pid = _pid_of(cl, "s")
+    snap0 = ps._accountant.snapshot()
+    rpc.call(ps.addr, "POST", "/ps/doc/search", {
+        "partition_id": pid, "vectors": {"v": [vecs[5].tolist()]},
+        "k": 3, "_hedge_extra": True,
+    })
+    snap1 = ps._accountant.snapshot()
+    assert _delta(snap0, snap1, "db/s", "hedge_extras") == 1
+    assert _delta(snap0, snap1, "db/s", "requests") == 0
+    _assert_conserved(snap1)
+
+
+def test_shed_429_and_slowlog_are_space_attributed(tmp_path, rng):
+    c = StandaloneCluster(data_dir=str(tmp_path / "shed"), n_ps=1,
+                          ps_kwargs={"heartbeat_interval": 0.3,
+                                     "max_concurrent_searches": 1})
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr)
+        cl.create_database("db")
+        _mk_space(cl, rng, "s")
+        ps = c.ps_nodes[0]
+        pid = _pid_of(cl, "s")
+
+        # slowlog entries carry the tenant: threshold ~0 logs everything
+        rpc.call(ps.addr, "POST", "/ps/engine/config", {
+            "partition_id": pid, "config": {"slow_log_ms": 0.001},
+        })
+        _search(c.router_addr, rng, "s")
+        log = rpc.call(ps.addr, "GET", "/debug/slowlog")
+        assert log["entries"], "threshold ~0 must log the search"
+        assert log["entries"][-1]["space"] == "db/s"
+
+        # saturate the single gate permit + single admission slot; the
+        # third concurrent request sheds with 429 and bills `sheds`
+        rpc.call(ps.addr, "POST", "/ps/engine/config", {
+            "partition_id": pid,
+            "config": {"admission_queue_limit": 1,
+                       "debug_search_delay_ms": 3000},
+        })
+        errs: list[Exception] = []
+
+        def occupy():
+            try:
+                _search(c.router_addr, rng, "s")
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=occupy, daemon=True,
+                                    name=f"acct-occupy-{i}")
+                   for i in range(2)]
+        try:
+            for t in threads:
+                t.start()
+            assert _poll(lambda: ps._admission.waiting >= 1, 5.0,
+                         0.01), "occupant never queued"
+            snap0 = ps._accountant.snapshot()
+            with pytest.raises(rpc.RpcError) as ei:
+                _search(c.router_addr, rng, "s")
+            assert ei.value.code == 429
+            snap1 = ps._accountant.snapshot()
+            assert _delta(snap0, snap1, "db/s", "sheds") == 1
+            _assert_conserved(snap1)
+            # the shed metric carries the space label
+            assert ('vearch_ps_admission_shed_total{op="search",'
+                    'space="db/s"}') in _scrape(ps.addr)
+        finally:
+            for t in threads:
+                t.join(timeout=10.0)
+            rpc.call(ps.addr, "POST", "/ps/engine/config", {
+                "partition_id": pid,
+                "config": {"admission_queue_limit": 0,
+                           "debug_search_delay_ms": 0},
+            })
+        assert not errs, errs
+    finally:
+        c.stop()
+
+
+# -- 2. co-batched apportionment ----------------------------------------------
+
+
+def test_apportion_device_us_is_integer_exact():
+    acct = SpaceAccountant()
+    # floor shares, remainder to the last share: 101µs over 3:1 rows
+    out = acct.apportion_device_us([("t/a", 3), ("t/b", 1)], 101)
+    assert out == [75, 26]
+    assert sum(out) == 101
+    snap = acct.snapshot()
+    assert snap["spaces"]["t/a"]["device_us"] == 75
+    assert snap["spaces"]["t/b"]["device_us"] == 26
+    _assert_conserved(snap)
+    # degenerate: all-zero rows still conserve (everything to the last)
+    assert sum(acct.apportion_device_us([("t/a", 0), ("t/b", 0)], 7)) == 7
+    # a share with no space bills the _system bucket
+    acct.apportion_device_us([(None, 5)], 9)
+    snap = acct.snapshot()
+    assert snap["spaces"][accounting.SYSTEM_SPACE]["device_us"] == 9
+    _assert_conserved(snap)
+
+
+def test_cobatched_bucket_splits_device_time_by_row_share():
+    """Two spaces' requests fused into ONE scheduler bucket: the
+    measured device time splits 3:1 by row share, exactly, with the
+    space binding carried across the dispatcher thread hop."""
+    from vearch_tpu.engine.batching import BatchScheduler
+    from vearch_tpu.engine.engine import Engine, SearchRequest
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+
+    accounting.install()
+    dd = 16
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal((400, dd)).astype(np.float32)
+    schema = TableSchema("m", [
+        FieldSchema("v", DataType.VECTOR, dimension=dd,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    eng.upsert([{"_id": str(i), "v": base[i]} for i in range(400)])
+    eng.build_index()
+    tag = uuid.uuid4().hex[:6]
+    sp_a, sp_b = f"unit/a-{tag}", f"unit/b-{tag}"
+    # huge age bound: the bucket dispatches only when FULL (3+1 rows),
+    # so the two submissions are guaranteed to co-batch
+    mb = BatchScheduler(eng, max_rows=4, max_delay_ms=3_600_000.0)
+    results: dict[str, list] = {}
+    try:
+        snap0 = ACCOUNTANT.snapshot()
+
+        def submit(space, q, key):
+            with accounting.billed(space):
+                results[key] = mb.submit(SearchRequest(
+                    vectors={"v": q}, k=2, include_fields=[]))
+
+        ta = threading.Thread(target=submit, args=(sp_a, base[:3], "a"),
+                              daemon=True, name="acct-cobatch-a")
+        tb = threading.Thread(target=submit, args=(sp_b, base[7:8], "b"),
+                              daemon=True, name="acct-cobatch-b")
+        ta.start()
+        # let A's 3 rows queue first so B's single row seals the bucket
+        time.sleep(0.1)
+        tb.start()
+        ta.join(timeout=30.0)
+        tb.join(timeout=30.0)
+        assert "a" in results and "b" in results
+        assert results["a"][0].items[0].key == "0"
+        assert results["b"][0].items[0].key == "7"
+        snap1 = ACCOUNTANT.snapshot()
+    finally:
+        mb.stop()
+
+    da = _delta(snap0, snap1, sp_a, "device_us")
+    db_ = _delta(snap0, snap1, sp_b, "device_us")
+    total = (snap1["totals"]["device_us"] - snap0["totals"]["device_us"])
+    assert da > 0 and db_ > 0
+    # exact conservation through the fused bucket: the two slices are
+    # the whole measured total, to the microsecond
+    assert da + db_ == total
+    # ... split by row share (3:1, up to integer flooring)
+    assert 2 * db_ < da < 4 * db_, (da, db_)
+    _assert_conserved(snap1)
+    # the bucket's discrete events (one dispatch, one upload) billed to
+    # exactly one of the two spaces — never both, never neither
+    ddisp_a = _delta(snap0, snap1, sp_a, "dispatches")
+    ddisp_b = _delta(snap0, snap1, sp_b, "dispatches")
+    assert ddisp_a + ddisp_b >= 1
+    assert min(ddisp_a, ddisp_b) == 0
+
+
+# -- 3. the metering is free on warmed paths ----------------------------------
+
+
+def test_warmed_path_zero_added_dispatches_zero_new_programs(cluster, rng):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    _mk_space(cl, rng, "s")
+    for _ in range(3):
+        _search(cluster.router_addr, rng, "s")
+    rpc.call(cluster.ps_nodes[0].addr, "POST", "/debug/compiles/reset")
+
+    programs0 = perf_model.total_compiled_programs()
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        _search(cluster.router_addr, rng, "s")
+        ledger.mark_search()
+        _search(cluster.router_addr, rng, "s")
+        ledger.mark_search()
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    per = ledger.per_search()
+    assert len(per) == 2 and per[0], per
+    # metering adds no dispatch anywhere: both warmed searches launch
+    # the identical documented program list
+    assert per[0] == per[1], per
+    # ... and compiles nothing new
+    assert perf_model.total_compiled_programs() == programs0
+    comp = rpc.call(cluster.ps_nodes[0].addr, "GET", "/debug/compiles")
+    assert comp["total"] == 0, comp
+
+
+# -- 4. SLO burn: router -> health -> doctor ----------------------------------
+
+
+def test_slo_fast_burn_pages_through_every_surface(cluster, rng):
+    from vearch_tpu.obs import doctor
+
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    # an objective every request violates: sub-microsecond latency
+    # target with a 99.9% availability budget -> burn 1000x
+    _mk_space(cl, rng, "s", slo={"latency_ms": 0.001,
+                                 "availability": 0.999})
+    for _ in range(3):
+        _search(cluster.router_addr, rng, "s")
+    rpc.call(cluster.ps_nodes[0].addr, "POST", "/debug/compiles/reset")
+    for _ in range(25):
+        _search(cluster.router_addr, rng, "s")
+
+    # router: per-space burn state on /router/stats
+    rstats = rpc.call(cluster.router_addr, "GET", "/router/stats")
+    rec = rstats["slo"]["db/s"]
+    assert rec["samples"] >= 25
+    assert rec["burn_fast"] >= accounting.FAST_BURN_THRESHOLD
+    assert rec["fast_burn"] is True
+    assert rec["latency_ms"]["0.5"] > 0
+    assert 'vearch_space_slo_burn_rate{space="db/s"}' in _scrape(
+        cluster.router_addr)
+
+    # master: the health rollup polls router slo digests, goes yellow,
+    # and names the burning space
+    def burning():
+        h = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+        return "db/s" in (h.get("slo_fast_burn_spaces") or [])
+
+    assert _poll(burning, 10.0), rpc.call(
+        cluster.master_addr, "GET", "/cluster/health")
+    health = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+    assert health["status"] in ("yellow", "red")
+
+    # cluster usage rollup: the space's meters rode the heartbeat up
+    def usage_ready():
+        u = rpc.call(cluster.master_addr, "GET", "/cluster/usage")
+        return u["spaces"].get("db/s", {}).get("requests", 0) >= 25
+
+    assert _poll(usage_ready, 10.0)
+    usage = rpc.call(cluster.master_addr, "GET", "/cluster/usage")
+    rec = usage["spaces"]["db/s"]
+    assert rec["device_ms"] > 0
+    assert rec["hbm_bytes"] > 0
+    assert "qps" in rec
+    assert any(c["space"] == "db/s" for c in usage["top_consumers"])
+    # rollup conservation: totals are the space sums for every meter
+    for meter in METERS:
+        assert usage["totals"][meter] == sum(
+            s[meter] for s in usage["spaces"].values()), meter
+
+    # doctor: seeded fast-burn is a named violation with exit code 1;
+    # the conservation check stays green
+    report, code = doctor.run(cluster.master_addr)
+    assert code == 1, doctor.format_report(report)
+    names = {c["name"] for c in report["checks"]}
+    assert {"slo_burn", "usage_conservation"} <= names
+    violated = {v["name"] for v in report["violations"]}
+    assert "slo_burn" in violated, report["violations"]
+    assert "usage_conservation" not in violated, report["violations"]
+    assert "db/s" in doctor.format_report(report)
+
+
+def test_master_validates_slo_declarations(cluster, rng):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    bad_slos = [
+        {"latency_ms": -5},
+        {"availability": 1.5},
+        {"availability": 0},
+        {"fast_burn_threshold": 2.0},  # no objective to burn against
+        "not-a-dict",
+    ]
+    for i, slo in enumerate(bad_slos):
+        with pytest.raises(rpc.RpcError) as ei:
+            cl.create_space("db", {
+                "name": f"bad{i}", "partition_num": 1, "replica_num": 1,
+                "slo": slo,
+                "fields": [{"name": "v", "data_type": "vector",
+                            "dimension": D,
+                            "index": {"index_type": "FLAT",
+                                      "metric_type": "L2",
+                                      "params": {}}}],
+            })
+        assert ei.value.code == 400, slo
+    # a valid objective round-trips on the space entity and is
+    # mutable online through the space-update path
+    _mk_space(cl, rng, "ok", slo={"latency_ms": 50,
+                                  "availability": 0.999})
+    assert cl.get_space("db", "ok")["slo"] == {
+        "latency_ms": 50, "availability": 0.999}

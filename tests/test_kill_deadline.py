@@ -110,9 +110,9 @@ def test_deadline_kills_between_dispatches_no_retry(cluster):
     # failover whitelist instead of re-running the killed work
     assert slow.calls == 1
 
-    # the kill is counted by reason...
+    # the kill is counted by reason (and attributed to the space)...
     page = _scrape(ps.addr)
-    assert 'vearch_requests_killed_total{reason="deadline"}' in page
+    assert 'vearch_requests_killed_total{reason="deadline",space=' in page
     # ...and force-sampled into the slowlog with its phase breakdown
     # (threshold 0 = disabled for ordinary requests, killed always log)
     log = rpc.call(ps.addr, "GET", "/debug/slowlog")
@@ -190,7 +190,7 @@ def test_operator_kill_between_dispatches(cluster):
     assert slow.calls == 1  # terminal: the router made no second attempt
 
     page = _scrape(ps.addr)
-    assert 'vearch_requests_killed_total{reason="operator"}' in page
+    assert 'vearch_requests_killed_total{reason="operator",space=' in page
     # killed-but-untraced requests are force-sampled into /debug/traces
     spans = _fetch_json(ps.addr, "/debug/traces")["spans"]
     forced = [s for s in spans

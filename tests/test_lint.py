@@ -58,8 +58,8 @@ def test_list_rules_names_every_rule():
         capture_output=True, text=True, cwd=REPO,
     )
     assert out.returncode == 0
-    for rid in ("VL101", "VL102", "VL103", "VL201", "VL202", "VL203",
-                "VL301", "VL302", "VL401"):
+    for rid in ("VL101", "VL102", "VL103", "VL104", "VL201", "VL202",
+                "VL203", "VL301", "VL302", "VL401"):
         assert rid in out.stdout, rid
 
 
@@ -186,6 +186,54 @@ def test_vl103_canonical_grid_must_match_policy_pin(tmp_path):
         """)
     assert _rules(found) == ["VL103"]
     assert "FETCH_K_TIERS" in found[0].message
+
+
+def test_vl104_unattributed_billable_counter_fires(tmp_path):
+    """A serving-path kill/shed counter incremented without naming the
+    space un-attributes that failure class — VL104."""
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        class PSServer:
+            def shed(self, lbl):
+                self._shed_total.inc("search")
+                self._killed_total.inc("deadline", lbl)
+        """)
+    assert _rules(found) == ["VL104"]
+    assert sorted(f.line for f in found) == [3, 4]
+    assert "space" in found[0].message
+
+
+def test_vl104_space_argument_passes(tmp_path):
+    """Any space-shaped argument — a `space_lbl` local, a
+    `_space_key()` call, a system-space constant — attributes the
+    increment."""
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        class PSServer:
+            def shed(self, pid):
+                space_lbl = self._acct.label(self._space_key(pid))
+                self._shed_total.inc("search", space_lbl)
+                self._killed_total.inc("deadline",
+                                       self._space_key(pid))
+                self._killed_total.inc("operator", SYSTEM_SPACE)
+        """)
+    assert found == []
+
+
+def test_vl104_inline_allow_and_other_files_pass(tmp_path):
+    """Genuinely tenant-free increments waive with a reason; the same
+    code outside the serving files is out of scope."""
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/router.py", """\
+        class Router:
+            def warm(self):
+                self._shed_total.inc(  # lint: allow[space-attr] zero-fill label registration
+                    "search", "other", by=0.0)
+        """)
+    assert found == []
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/master.py", """\
+        class Master:
+            def note(self):
+                self._shed_total.inc("search")
+        """)
+    assert found == []
 
 
 def test_vl201_unguarded_mutation_fires(tmp_path):

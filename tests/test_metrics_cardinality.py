@@ -122,6 +122,74 @@ def test_profiled_soak_does_not_grow_series(cluster, rng):
         assert len(_series(text)) <= SERIES_CEILING, addr
 
 
+def test_space_churn_soak_stays_under_series_ceiling(cluster, rng):
+    """Tenant-churn mirror of the search soak: 50 spaces churning
+    through the cost accountant must not scale the series set — the
+    top-K + `other` label policy (docs/ACCOUNTING.md) bounds the
+    per-space metrics by POLICY, not by tenant count, while the exact
+    per-space figures survive on the JSON surfaces."""
+    from vearch_tpu.obs.accounting import (
+        ACCOUNTANT, OTHER_LABEL, SPACE_LABEL_TOPK,
+    )
+
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 1,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((20, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(20)])
+    rpc.call(cluster.router_addr, "POST", "/document/search", {
+        "db_name": "db", "space_name": "s",
+        "vectors": [{"field": "v", "feature": vecs[0].tolist()}],
+        "limit": 3,
+    })
+    addrs = [ps.addr for ps in cluster.ps_nodes]
+    try:
+        # exhaust the label budget deliberately (the cluster's real
+        # traffic already owns some of it), then baseline: everything
+        # past this point MUST collapse into `other`
+        for i in range(SPACE_LABEL_TOPK + 2):
+            ACCOUNTANT.charge("requests", 1, space=f"churn/t{i}")
+        mid = {a: _series(scrape(a)) for a in addrs}
+
+        # the churn: 50 tenants accruing every expensive meter
+        for i in range(50):
+            sp = f"churn/t{i}"
+            ACCOUNTANT.charge("requests", 3, space=sp)
+            ACCOUNTANT.charge("device_us", 1234, space=sp)
+            ACCOUNTANT.charge("h2d_bytes", 1 << 20, space=sp)
+            ACCOUNTANT.charge("dispatches", 2, space=sp)
+            ACCOUNTANT.charge("queue_wait_us", 55, space=sp)
+
+        for addr in addrs:
+            text = scrape(addr)
+            grown = _series(text) - mid[addr]
+            assert not grown, (
+                f"{addr}: tenant churn minted series: {grown}")
+            # every space metric is bounded by the label policy and the
+            # collapsed bucket is rendering
+            labels = re.findall(
+                r'vearch_space_requests_total\{space="([^"]+)"\}', text)
+            assert labels, "space metrics must render"
+            assert len(labels) <= SPACE_LABEL_TOPK + 2, labels
+            assert OTHER_LABEL in labels, labels
+            assert len(_series(text)) <= SERIES_CEILING, addr
+
+        # no collapse on the JSON surface: all 50 tenants exact
+        snap = ACCOUNTANT.snapshot()
+        churned = [s for s in snap["spaces"] if s.startswith("churn/")]
+        assert len(churned) == 50
+        assert all(snap["spaces"][s]["requests"] >= 1 for s in churned)
+    finally:
+        # hand the first-come label budget back to later tests
+        ACCOUNTANT.reset()
+
+
 def test_cached_soak_does_not_grow_series(cluster, rng):
     """Cache-tier mirror of the search soak: 1k queries served almost
     entirely from the router/PS result caches (plus coalesced groups)

@@ -18,6 +18,7 @@ compiles surface as first-class health signals:
   violation named once one is injected.
 """
 
+import gc
 import re
 import time
 import urllib.request
@@ -112,8 +113,14 @@ def test_hbm_drift_gate_flips_gauge_and_health(cluster):
     cl.search("db", "s", [{"field": "v", "feature": vecs[1]}], limit=3)
 
     # healthy: measured HBM within model + baseline on every node, the
-    # drift gauge renders 0, and the rollup carries no drift nodes
+    # drift gauge renders 0, and the rollup carries no drift nodes.
+    # Other suites in this process may still hold unreferenced device
+    # garbage; collect it and poll — buffer teardown is asynchronous.
+    gc.collect()
     for ps in cluster.ps_nodes:
+        sampler = ps.device_sampler
+        assert _poll(lambda: not sampler.sample_now()["drift"], 10.0), \
+            sampler.sample_now()
         snap = ps.device_sampler.sample_now()
         assert snap["samples"] >= 1
         assert snap["devices"], "sampler saw no devices"
@@ -154,9 +161,12 @@ def test_hbm_drift_gate_flips_gauge_and_health(cluster):
             cluster.master_addr, "GET", "/cluster/health")
     finally:
         del blob
-    # dropping the allocation clears the drift on the next sample
-    snap = cluster.ps_nodes[0].device_sampler.sample_now()
-    assert not snap["drift"], snap
+    # dropping the allocation clears the drift — device buffer
+    # deletion is asynchronous, so collect and poll for the clear
+    gc.collect()
+    sampler = cluster.ps_nodes[0].device_sampler
+    assert _poll(lambda: not sampler.sample_now()["drift"], 10.0), \
+        sampler.sample_now()
 
 
 def test_h2d_and_compiled_program_gauges_render(cluster):

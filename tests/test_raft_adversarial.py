@@ -130,6 +130,9 @@ class Cluster:
         self.members = list(range(1, N_NODES + 1))
         self.term = 1
         self.leader = 1
+        # promotion watermark (master.py promoted_log): the best log
+        # position chosen at the last successful reconfigure
+        self.promoted_log = (0, 0)
         # (term -> set of node ids that successfully committed proposes)
         self.committers: dict[int, set] = {}
         self._commit_lock = threading.Lock()
@@ -199,6 +202,14 @@ class Cluster:
             return False
         best = max(states, key=lambda r: (states[r]["last_term"],
                                           states[r]["last_index"]))
+        best_log = (int(states[best]["last_term"]),
+                    int(states[best]["last_index"]))
+        # chained-reconfiguration floor (master.py promoted_log): the
+        # intersection bound only covers commits made under the CURRENT
+        # membership; commits from an earlier membership may live solely
+        # in the previously promoted leader's log until peers catch up
+        if best_log < self.promoted_log:
+            return False
         members = sorted(states)
         try:
             self.nodes[best].become_leader(new_term, members)
@@ -207,6 +218,7 @@ class Cluster:
         self.term = new_term
         self.members = members
         self.leader = best
+        self.promoted_log = best_log
         for r in members:
             if r != best:
                 try:
@@ -298,7 +310,7 @@ def _run_schedule(tmp_path, seed: int) -> None:
     # the writer MUST be dead before checking: an in-flight propose
     # completing mid-check mutates logs/acked under the assertions
     # (observed as a spurious divergence under full-suite load)
-    t.join(timeout=60.0)
+    t.join(timeout=120.0)
     assert not t.is_alive(), f"seed {seed}: writer stuck in propose"
     assert not writer_err, f"seed {seed}: writer crashed: {writer_err[0]}"
 
@@ -318,7 +330,7 @@ def _run_schedule(tmp_path, seed: int) -> None:
             cluster.reconfigure()
             return False
 
-    if not _poll(_try_marker, 30.0, 0.01):
+    if not _poll(_try_marker, 90.0, 0.01):
         pytest.fail(f"seed {seed}: no leader converged after heal")
     # drain replication to all final members: tick the leader until
     # everyone applied the marker (condition-gated, not a fixed count —
@@ -334,7 +346,7 @@ def _run_schedule(tmp_path, seed: int) -> None:
     _poll(lambda: all(
         cluster.states[m] and cluster.states[m][-1] == marker
         for m in cluster.members
-    ), 30.0, 0.02, on_tick=_drain_tick)
+    ), 90.0, 0.02, on_tick=_drain_tick)
 
     final = cluster.states[cluster.leader]
     try:
@@ -507,7 +519,7 @@ def _run_voted_schedule(tmp_path, seed: int) -> None:
         else:
             net.heal()
     stop.set()
-    t.join(timeout=60.0)
+    t.join(timeout=120.0)
     assert not t.is_alive(), f"voted seed {seed}: writer stuck"
     net.heal()
 
@@ -528,12 +540,12 @@ def _run_voted_schedule(tmp_path, seed: int) -> None:
         except RpcError:
             return False
 
-    if not _poll(_try_vmarker, 35.0, 0.05):
+    if not _poll(_try_vmarker, 90.0, 0.05):
         cluster.close()
         pytest.fail(f"voted seed {seed}: no leader after heal")
     _poll(lambda: all(
         s and s[-1] == marker for s in cluster.states.values()
-    ), 25.0, 0.05)
+    ), 75.0, 0.05)
 
     final = max(cluster.states.values(), key=len)
     try:

@@ -306,8 +306,8 @@ def test_saturated_ps_sheds_while_healthy_partitions_serve(
                        "debug_search_delay_ms": 0},
         })
     assert not occupants, occupants
-    # sheds are counted per-op on the PS metrics page
-    assert 'vearch_ps_admission_shed_total{op="search"}' in _scrape(
+    # sheds are counted per-op (and per-space) on the PS metrics page
+    assert 'vearch_ps_admission_shed_total{op="search",space=' in _scrape(
         ps_a.addr)
     # recovered: the formerly saturated space serves again
     assert _timed_search(router.addr, rng, "a") < 1.0

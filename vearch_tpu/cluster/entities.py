@@ -48,6 +48,11 @@ class Partition:
     # it so routers detect a split cutover without waiting for the
     # metastore watch
     map_version: int = 0
+    # (last_term, last_index) of the leader log chosen at the most
+    # recent promotion — the floor a later promotion's candidate must
+    # reach, or entries committed under an earlier membership could be
+    # discarded (master.py _reconfigure_partition)
+    promoted_log: list[int] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return dict(self.__dict__)
@@ -91,6 +96,11 @@ class Space:
     # partition-map epoch: bumped by every split cutover; routers
     # compare against response-carried versions to hot-reload the map
     map_version: int = 0
+    # declared service objective for this space, e.g.
+    # {"latency_ms": 50, "availability": 0.999} — the router scores
+    # every logical search against it and exports error-budget burn
+    # rates (docs/ACCOUNTING.md); None = unscored
+    slo: dict | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -114,6 +124,8 @@ class Space:
             d["pre_expand_pids"] = list(self.pre_expand_pids)
         if self.map_version:
             d["map_version"] = self.map_version
+        if self.slo:
+            d["slo"] = dict(self.slo)
         return d
 
     @classmethod
@@ -132,6 +144,7 @@ class Space:
             expanded=bool(d.get("expanded", False)),
             pre_expand_pids=[int(x) for x in d.get("pre_expand_pids", [])],
             map_version=int(d.get("map_version", 0)),
+            slo=d.get("slo"),
         )
 
     def slot_starts(self) -> list[int]:

@@ -186,6 +186,7 @@ class MasterServer:
         s.route("GET", "/servers", self._h_servers)
         s.route("GET", "/routers", self._h_routers)
         s.route("GET", "/cluster/stats", self._h_cluster_stats)
+        s.route("GET", "/cluster/usage", self._h_cluster_usage)
         s.route("GET", "/cluster/health", self._h_cluster_health)
         s.route("GET", "/members", self._h_members)
         s.route("POST", "/members/add", self._h_member_add)
@@ -269,6 +270,18 @@ class MasterServer:
         # would churn the metastore (and fire every watch) at 0.5Hz
         # times the fleet size
         self._node_loads: dict[int, dict] = {}
+        # per-tenant usage meters riding the same heartbeat
+        # (docs/ACCOUNTING.md): node_id -> {scope_id, spaces, totals,
+        # hbm_bytes, _mono}. In-memory like the rest — it changes every
+        # heartbeat. The rollup dedups by scope_id: co-located PS nodes
+        # share one process accountant and must not double-count.
+        self._node_usage: dict[int, dict] = {}
+        # previous per-space request counts per scope, for the QPS
+        # estimate GET /cluster/usage derives from heartbeat deltas
+        self._usage_prev: dict[str, dict] = {}
+        # router SLO digests pulled on demand by /cluster/health,
+        # memoized a few seconds so health probes stay cheap
+        self._router_slo_memo: tuple[float, dict] = (0.0, {})
         self._register_cluster_gauges()
 
         if self.replicated:
@@ -688,10 +701,15 @@ class MasterServer:
         Safety: promotion requires that the alive replicas intersect
         every possible commit quorum of the old membership — i.e. at
         least n - quorum(n) + 1 of n replicas reachable. The max-log
-        replica among such a set necessarily holds every committed
-        (acked) entry, so promotion never loses an acked write. Below
-        that threshold the partition stays unavailable (leaderless)
-        rather than silently dropping acked data."""
+        replica among such a set holds every entry committed UNDER THE
+        CURRENT membership. Entries committed under an earlier
+        membership are only guaranteed in the log of the leader chosen
+        at the previous reconfiguration, so each promotion also records
+        that leader's (last_term, last_index) as `promoted_log` and a
+        later promotion refuses any candidate behind it (see
+        _reconfigure_partition). Below either threshold the partition
+        stays unavailable (leaderless) rather than silently dropping
+        acked data."""
         servers = {s.node_id: s for s in self._alive_servers()}
         with self._reconfig_lock:
             for key, sp in self.store.prefix(PREFIX_SPACE).items():
@@ -731,10 +749,25 @@ class MasterServer:
             states,
             key=lambda r: (states[r]["last_term"], states[r]["last_index"]),
         )
+        best_log = (int(states[best]["last_term"]),
+                    int(states[best]["last_index"]))
+        # chained-reconfiguration floor: the intersection bound above
+        # only covers entries committed under the CURRENT membership.
+        # Entries committed under an earlier membership can live solely
+        # in the log of the leader promoted at the previous reconfigure
+        # until its peers catch up — fencing a set that excludes that
+        # leader while a survivor still lags would promote a stale log
+        # and discard acked writes. Refuse until some candidate reaches
+        # the recorded watermark; WALs are durable, so the floor becomes
+        # satisfiable again when the log-holder returns.
+        floor = p.get("promoted_log")
+        if floor is not None and best_log < (int(floor[0]), int(floor[1])):
+            return False
         members = sorted(states)
         p["leader"] = best
         p["term"] = new_term
         p["replicas"] = members
+        p["promoted_log"] = list(best_log)
         try:
             rpc.call(servers[best].rpc_addr, "POST", "/ps/raft/lead",
                      {"pid": p["id"], "term": new_term, "members": members})
@@ -1010,6 +1043,126 @@ class MasterServer:
             for nid, srv in sorted(servers.items())
         ]}
 
+    def _h_cluster_usage(self, _body, _parts) -> dict:
+        """Cluster-wide per-tenant usage rollup (docs/ACCOUNTING.md):
+        the heartbeat-fed per-node accountant snapshots summed by
+        space, deduplicated by accountant scope (co-located PS nodes in
+        one process share one accountant — billing each scope once
+        keeps the rollup conservation-exact), plus a QPS estimate from
+        consecutive heartbeat deltas and the top consumers by
+        device time."""
+        from vearch_tpu.obs import accounting
+
+        fwd = self._leader_get("/cluster/usage")
+        if fwd is not None:
+            return fwd
+        servers = {s.node_id: s for s in self._alive_servers()}
+        spaces: dict[str, dict] = {}
+        totals = {m: 0 for m in accounting.METERS}
+        hbm: dict[str, int] = {}
+        qps: dict[str, float] = {}
+        seen_scopes: list[str] = []
+        for nid in sorted(servers):
+            u = self._node_usage.get(nid)
+            if not u:
+                continue
+            # HBM residency is per-NODE (each PS models its own hosted
+            # engines), so it sums across every reporter even when the
+            # meter scope is shared
+            for sp, n in (u.get("hbm_bytes") or {}).items():
+                hbm[sp] = hbm.get(sp, 0) + int(n)
+            scope = str(u.get("scope_id") or f"node-{nid}")
+            if scope in seen_scopes:
+                continue
+            seen_scopes.append(scope)
+            for sp, meters in (u.get("spaces") or {}).items():
+                acc = spaces.setdefault(
+                    sp, {m: 0 for m in accounting.METERS})
+                for mname, v in meters.items():
+                    if mname in acc:
+                        acc[mname] += int(v)
+            for mname, v in (u.get("totals") or {}).items():
+                if mname in totals:
+                    totals[mname] += int(v)
+            # QPS from consecutive heartbeat deltas of the requests
+            # meter, per scope (monotonic stamps; a re-sent snapshot
+            # contributes zero, never a negative rate)
+            mono = float(u.get("_mono") or 0.0)
+            prev = self._usage_prev.get(scope)
+            if prev is not None and mono > prev["mono"]:
+                dt = mono - prev["mono"]
+                for sp, meters in (u.get("spaces") or {}).items():
+                    d = int(meters.get("requests", 0)) - int(
+                        prev["req"].get(sp, 0))
+                    if d > 0:
+                        qps[sp] = qps.get(sp, 0.0) + d / dt
+            if prev is None or mono > prev["mono"]:
+                self._usage_prev[scope] = {
+                    "mono": mono,
+                    "req": {sp: int(m.get("requests", 0))
+                            for sp, m in (u.get("spaces") or {}).items()},
+                }
+        ranked = sorted(spaces.items(),
+                        key=lambda kv: kv[1]["device_us"], reverse=True)
+        return {
+            "spaces": {
+                sp: {**m,
+                     "device_ms": round(m["device_us"] / 1e3, 3),
+                     "qps": round(qps.get(sp, 0.0), 2),
+                     "hbm_bytes": hbm.get(sp, 0)}
+                for sp, m in spaces.items()
+            },
+            "totals": {**totals,
+                       "device_ms": round(totals["device_us"] / 1e3, 3)},
+            "top_consumers": [
+                {"space": sp,
+                 "device_ms": round(m["device_us"] / 1e3, 3),
+                 "dispatches": m["dispatches"],
+                 "h2d_bytes": m["h2d_bytes"],
+                 "requests": m["requests"],
+                 "qps": round(qps.get(sp, 0.0), 2)}
+                for sp, m in ranked[:10]
+            ],
+            "scopes": seen_scopes,
+        }
+
+    def _router_slo_digest(self) -> dict[str, dict]:
+        """Merged per-space SLO burn state pulled from every registered
+        router's /router/stats, memoized a few seconds so health probes
+        stay cheap. Per space, the WORST burn across routers wins (each
+        router only sees its own share of the traffic). Unreachable
+        routers are skipped — health degradation must not depend on
+        every router answering."""
+        now = time.monotonic()
+        ts, memo = self._router_slo_memo
+        if now - ts < 5.0:
+            return memo
+        merged: dict[str, dict] = {}
+        for rec in list(self.store.prefix("/router/").values()):
+            addr = rec.get("addr")
+            if not addr:
+                continue
+            try:
+                stats = rpc.call(addr, "GET", "/router/stats",
+                                 timeout=2.0)
+            except RpcError:
+                continue
+            for space, s in (stats.get("slo") or {}).items():
+                cur = merged.setdefault(space, {
+                    "burn_fast": 0.0, "burn_slow": 0.0,
+                    "fast_burn": False, "samples": 0,
+                    "objective": s.get("objective"),
+                })
+                cur["burn_fast"] = max(cur["burn_fast"],
+                                       float(s.get("burn_fast") or 0.0))
+                cur["burn_slow"] = max(cur["burn_slow"],
+                                       float(s.get("burn_slow") or 0.0))
+                cur["fast_burn"] = bool(cur["fast_burn"]
+                                        or s.get("fast_burn"))
+                cur["samples"] += int(s.get("samples") or 0)
+        self._router_slo_memo = (now, merged)
+        return merged
+
     def _h_cluster_health(self, _body, _parts) -> dict:
         """Per-space health roll-up (reference: cluster_api.go health):
         green = every partition leader-alive and fully replicated,
@@ -1095,7 +1248,16 @@ class MasterServer:
         )
         if drift_nodes and rank[status] < rank["yellow"]:
             status = "yellow"
+        # SLO degradation: a space burning its declared error budget at
+        # page rate (router-scored fast window) is a tenant-visible
+        # incident even while every partition is green-replicated
+        slo = self._router_slo_digest()
+        slo_burn_spaces = sorted(
+            sp for sp, rec in slo.items() if rec.get("fast_burn"))
+        if slo_burn_spaces and rank[status] < rank["yellow"]:
+            status = "yellow"
         return {"status": status, "spaces": spaces,
+                "slo_fast_burn_spaces": slo_burn_spaces,
                 "hbm_drift_nodes": drift_nodes,
                 "serving_compiles": sum(
                     int(obs.get("compiles_post_warmup") or 0)
@@ -1310,6 +1472,10 @@ class MasterServer:
             self._node_obs[node_id] = body["obs"] or {}
         if "load" in body:
             self._node_loads[node_id] = body["load"] or {}
+        if "usage" in body:
+            usage = dict(body["usage"] or {})
+            usage["_mono"] = time.monotonic()
+            self._node_usage[node_id] = usage
         # field-index + schema expectations for the partitions this node
         # hosts: heals replicas that missed a /field_index or
         # /ps/schema/field fan-out (transient RPC failure, or a restart
@@ -1452,6 +1618,11 @@ class MasterServer:
                     # unchanged replica_num: read-modify-write clients
                     # resubmit the whole space config
                     self._expand_partitions(space, pn)
+            if "slo" in body:
+                # declared objective is online-mutable: routers pick
+                # the change up on their next metadata fetch (one
+                # cache TTL) and rescore from there
+                space.slo = self._validate_slo(body.get("slo"))
             self.store.put(key, space.to_dict())
         finally:
             self._unlock_space(db, name, token)
@@ -2468,6 +2639,12 @@ class MasterServer:
             p["leader"] = new_leader
             p["term"] = term
             p["learners"] = []
+            # promotion watermark for later reconfigures (same contract
+            # as _reconfigure_partition): the new leader was verified to
+            # cover the incumbent's log, so its fenced position bounds
+            # everything committed so far
+            p["promoted_log"] = [int(states[new_leader]["last_term"]),
+                                 int(states[new_leader]["last_index"])]
             self.store.put(key, sp)
             if pid not in target.partition_ids:
                 target.partition_ids.append(pid)
@@ -2692,11 +2869,13 @@ class MasterServer:
                     400, f"anti_affinity {anti!r} must be one of "
                          f"none/host/rack/zone"
                 )
+            slo = self._validate_slo(body.get("slo"))
             space = Space(
                 id=space_id, name=name, db_name=db, schema=schema,
                 partition_num=partition_num, replica_num=replica_num,
                 partition_rule=rule, anti_affinity=anti,
                 enable_id_cache=bool(body.get("enable_id_cache", True)),
+                slo=slo,
             )
             # with a partition rule, every range backs its own group of
             # partition_num slot-sharded partitions (reference: a 3-range
@@ -2708,6 +2887,36 @@ class MasterServer:
             return space.to_dict()
         finally:
             self._unlock_space(db, name, token)
+
+    @staticmethod
+    def _validate_slo(slo) -> dict | None:
+        """Sanity-check a declared space SLO at admission time so the
+        router's burn-rate math never divides by a nonsense budget."""
+        if not slo:
+            return None
+        if not isinstance(slo, dict):
+            raise RpcError(400, "slo must be an object")
+        out: dict = {}
+        if slo.get("latency_ms") is not None:
+            lat = float(slo["latency_ms"])
+            if lat <= 0:
+                raise RpcError(400, "slo.latency_ms must be > 0")
+            out["latency_ms"] = lat
+        if slo.get("availability") is not None:
+            avail = float(slo["availability"])
+            if not 0.0 < avail < 1.0:
+                raise RpcError(
+                    400, "slo.availability must be in (0, 1)")
+            out["availability"] = avail
+        if slo.get("fast_burn_threshold") is not None:
+            thr = float(slo["fast_burn_threshold"])
+            if thr <= 0:
+                raise RpcError(400, "slo.fast_burn_threshold must be > 0")
+            out["fast_burn_threshold"] = thr
+        if "latency_ms" not in out and "availability" not in out:
+            raise RpcError(
+                400, "slo must declare latency_ms and/or availability")
+        return out
 
     def _validate_rule(self, rule: dict, schema: TableSchema) -> None:
         from vearch_tpu.cluster.entities import rule_value_ns

@@ -35,6 +35,7 @@ from vearch_tpu.cluster.metrics import (
     register_tracer_metrics,
 )
 from vearch_tpu.cluster.raft import RaftNode
+from vearch_tpu.obs import accounting
 from vearch_tpu.ops import perf_model
 from vearch_tpu.cluster.rpc import (
     ERR_REQUEST_KILLED,
@@ -430,14 +431,54 @@ class PSServer:
         self._killed_total = m.counter(
             "vearch_requests_killed_total",
             "in-flight requests aborted, by reason "
-            "(deadline/slow/operator)",
-            ("reason",))
+            "(deadline/slow/operator) and tenant space",
+            ("reason", "space"))
         self._shed_total = m.counter(
             "vearch_ps_admission_shed_total",
             "requests shed (429) by admission control before any "
-            "device work, per op",
-            ("op",))
-        self._shed_total.inc("search", by=0.0)  # render from 1st scrape
+            "device work, per op and tenant space",
+            ("op", "space"))
+        # render from 1st scrape; no tenant has been admitted yet
+        self._shed_total.inc(  # lint: allow[space-attr] zero-fill render
+            "search", accounting.OTHER_LABEL, by=0.0)
+
+        # -- per-tenant cost accounting (docs/ACCOUNTING.md) -----------
+        # The process-global accountant hooks the dispatch + H2D
+        # ledgers; these callback metrics render its meters under the
+        # fixed top-K + "other" label policy, so series stay bounded no
+        # matter how many spaces this node hosts. Exact per-space
+        # numbers ride /ps/stats and the heartbeat usage block.
+        self._accountant = accounting.install()
+
+        def _usage(meter: str, scale: float = 1.0):
+            return lambda: self._accountant.labelled(meter, scale)
+
+        m.callback_counter("vearch_space_requests_total",
+                           "search RPCs billed per space (won hedges "
+                           "bill once)", ("space",), _usage("requests"))
+        m.callback_counter("vearch_space_dispatches_total",
+                           "device dispatches attributed per space "
+                           "(reconciles with the dispatch ledger)",
+                           ("space",), _usage("dispatches"))
+        m.callback_counter("vearch_space_h2d_bytes_total",
+                           "host->device bytes attributed per space "
+                           "(reconciles with vearch_ps_h2d_bytes_total)",
+                           ("space",), _usage("h2d_bytes"))
+        m.callback_counter("vearch_space_device_ms_total",
+                           "engine device wall-time per space, ms "
+                           "(co-batched buckets split by row share)",
+                           ("space",), _usage("device_us", 1e-3))
+        m.callback_counter("vearch_space_queue_wait_ms_total",
+                           "admission-gate + scheduler queue wait per "
+                           "space, ms", ("space",),
+                           _usage("queue_wait_us", 1e-3))
+        m.callback_counter("vearch_space_cache_hits_total",
+                           "result-cache hits per space (zero device "
+                           "cost)", ("space",), _usage("cache_hits"))
+        m.callback_gauge("vearch_space_hbm_bytes",
+                         "modelled device-memory residency per space "
+                         "on this node", ("space",),
+                         self._space_hbm_labelled)
         self._wal_fsync_hist = m.histogram(
             "vearch_wal_fsync_latency_seconds",
             "WAL fsync wall time per append batch",
@@ -902,6 +943,31 @@ class PSServer:
                 continue
         return out
 
+    def _space_key(self, pid: int) -> str:
+        """The billing key ("db/space") for a hosted partition; the
+        `_system` bucket when the partition record is unknown (e.g.
+        a dev-mode engine opened outside the metastore)."""
+        part = self.partitions.get(pid)
+        if part is None or not getattr(part, "space_name", None):
+            return accounting.SYSTEM_SPACE
+        return f"{part.db_name}/{part.space_name}"
+
+    def _usage_summary(self) -> dict:
+        """Per-tenant meter snapshot riding the heartbeat: the process
+        accountant's exact per-space dict (never label-collapsed) plus
+        this node's per-space HBM residency split. The master rolls
+        these up into GET /cluster/usage, deduplicating accountant
+        scopes shared by co-located nodes."""
+        snap = self._accountant.snapshot()
+        return {
+            "scope_id": snap["scope_id"],
+            "spaces": snap["spaces"],
+            "totals": snap["totals"],
+            "hbm_bytes": {
+                sp: int(n) for sp, n in self._space_device_bytes().items()
+            },
+        }
+
     def _obs_summary(self) -> dict:
         """Drift + compile digest riding the heartbeat."""
         samp = self.device_sampler.snapshot()
@@ -953,7 +1019,11 @@ class PSServer:
                      # rollup degrades on drift without polling us
                      "obs": self._obs_summary(),
                      # load digest for least-loaded replica routing
-                     "load": self._load_summary()},
+                     "load": self._load_summary(),
+                     # per-tenant meters; scope_id lets the master
+                     # dedup co-located nodes sharing one process
+                     # accountant (docs/ACCOUNTING.md)
+                     "usage": self._usage_summary()},
                     auth=self.master_auth,
                 )
             except RpcError:
@@ -1444,9 +1514,13 @@ class PSServer:
         t0 = time.monotonic()
         with self._stats_lock:
             self._op_inflight["write"] += 1
+        # write-path H2D bytes (appends pushing rows to device) bill to
+        # the owning space, not the _system bucket
+        _space_token = accounting.set_space(self._space_key(pid))
         try:
             return fn(body, parts)
         finally:
+            accounting.reset_space(_space_token)
             with self._stats_lock:
                 self._op_inflight["write"] -= 1
             ms = (time.monotonic() - t0) * 1e3
@@ -1729,6 +1803,15 @@ class PSServer:
         }
         pid = int(body["partition_id"])
         self._count_op(pid, "searches")
+        # tenant resolution happens before admission so even a shed 429
+        # is attributable (docs/ACCOUNTING.md)
+        space_key = self._space_key(pid)
+        space_lbl = self._accountant.label(space_key)
+        # the router marks its duplicate hedge attempt: device work it
+        # causes bills honestly, but the logical request bills once
+        hedge_extra = bool(body.get("_hedge_extra"))
+        q0 = next(iter(vectors.values()))
+        qrows = 1 if q0.ndim == 1 else int(q0.shape[0])
         # slow-channel routing: partitions with a slow recent history go
         # through the small slow gate; everyone else uses the fast gate
         slow = bool(
@@ -1744,7 +1827,8 @@ class PSServer:
         # the 429 carries a Retry-After estimate for the SDK's backoff
         if not self._admission.try_admit(
                 priority=int(body.get("priority") or 0)):
-            self._shed_total.inc("search")
+            self._shed_total.inc("search", space_lbl)
+            self._accountant.charge("sheds", 1, space=space_key)
             raise RpcError(
                 429,
                 f"partition server shedding: admission queue full "
@@ -1770,6 +1854,8 @@ class PSServer:
         with self._stats_lock:
             self._op_inflight["search"] += 1
         gate_wait_ms = round((time.monotonic() - t_gate) * 1e3, 3)
+        self._accountant.charge("queue_wait_us", int(gate_wait_ms * 1e3),
+                                space=space_key)
         rid = str(body.get("request_id") or uuid.uuid4().hex)
         token = uuid.uuid4().hex  # unique even when clients reuse rids
         # per-request deadline: the search option wins, else the PS-wide
@@ -1816,6 +1902,10 @@ class PSServer:
         from vearch_tpu.obs import flight_recorder as _flightrec
 
         _trace_token = _flightrec.set_active_trace(span.trace_id or rid)
+        # cost attribution: every dispatch / H2D byte / device slice the
+        # engine produces for this request bills to this space (the
+        # batch scheduler carries the binding across its thread hop)
+        _space_token = accounting.set_space(space_key)
         try:
             with span:
                 if self.debug_search_delay_ms:
@@ -1845,6 +1935,11 @@ class PSServer:
                 # stale map learns of a split cutover from any response
                 out["map_version"] = self._map_version(pid)
                 span.set_tag("cache", cache_status)
+                if cache_status in ("hit", "coalesced"):
+                    # served from memo: billed to the hitting space at
+                    # zero device cost (no engine work ran for it)
+                    self._accountant.charge("cache_hits", 1,
+                                            space=space_key)
                 if timing is not None:
                     timing["gate_wait_ms"] = gate_wait_ms
                     # engine phase windows -> real child spans under
@@ -1883,7 +1978,7 @@ class PSServer:
                 return out
         except RequestKilled as e:
             reason = ctx.reason_code or "operator"
-            self._killed_total.inc(reason)
+            self._killed_total.inc(reason, space_lbl)
             # force-sample killed requests: even an untraced request
             # leaves a span in /debug/traces explaining the abort
             if span is NULL_SPAN:
@@ -1901,6 +1996,16 @@ class PSServer:
                            f"request_killed: request {rid}: {e}") from e
         finally:
             _flightrec.reset_active_trace(_trace_token)
+            accounting.reset_space(_space_token)
+            # per-tenant billing: one logical request (the router's
+            # duplicate hedge attempt meters separately so a won hedge
+            # bills once), its query rows, and any abort
+            self._accountant.charge(
+                "hedge_extras" if hedge_extra else "requests", 1,
+                space=space_key)
+            self._accountant.charge("rows", qrows, space=space_key)
+            if ctx.killed:
+                self._accountant.charge("kills", 1, space=space_key)
             with self._inflight_lock:
                 self._inflight.pop(token, None)
             gate.release()
@@ -1919,6 +2024,7 @@ class PSServer:
                 t = trace or {}
                 self.slowlog.add({
                     "request_id": rid, "partition": pid, "op": "search",
+                    "space": space_key,
                     "elapsed_ms": round(ms, 3),
                     "killed": ctx.killed, "reason": ctx.reason,
                     "phases": {k[:-len("_ms")]: v for k, v in t.items()
@@ -2200,6 +2306,32 @@ class PSServer:
                 except Exception:
                     continue
         return total
+
+    def _space_device_bytes(self) -> dict[str, int]:
+        """Per-space split of :meth:`_model_device_bytes` — the same
+        engines grouped by owning space, so the values sum to the node
+        total exactly (partitions without a known space accrue to the
+        `_system` bucket, keeping the conservation identity)."""
+        out: dict[str, int] = {}
+        for pid, eng in list(self.engines.items()):
+            sp = self._space_key(pid)
+            n = 0
+            for idx in list(getattr(eng, "indexes", {}).values()):
+                try:
+                    n += int(idx.device_footprint_per_device_bytes())
+                except Exception:
+                    continue
+            out[sp] = out.get(sp, 0) + n
+        return out
+
+    def _space_hbm_labelled(self) -> dict[tuple[str, ...], float]:
+        """vearch_space_hbm_bytes callback: the per-space residency
+        split collapsed under the accountant's top-K label policy."""
+        out: dict[tuple[str, ...], float] = {}
+        for sp, n in self._space_device_bytes().items():
+            key = (self._accountant.label(sp),)
+            out[key] = out.get(key, 0.0) + float(n)
+        return out
 
     # -- online partition split (elastic data plane) -------------------------
     #
@@ -2869,6 +3001,10 @@ class PSServer:
             # admission-control counters (sheds, waiters, limit) — the
             # doctor's shed-rate check reads these
             "admission": self._admission.snapshot(),
+            # per-tenant cost meters (exact keys, never label-collapsed)
+            # + this node's per-space HBM residency split — the same
+            # block the heartbeat carries (docs/ACCOUNTING.md)
+            "usage": self._usage_summary(),
             # snapshot under no lock: stale reads are fine for stats
             "search_ewma_ms": {
                 str(pid): round(ms, 2)

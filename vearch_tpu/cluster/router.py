@@ -20,6 +20,7 @@ from typing import Any
 from vearch_tpu.cluster import rpc
 from vearch_tpu.cluster.entities import Server, Space
 from vearch_tpu.cluster.rpc import ERR_REQUEST_KILLED, JsonRpcServer, RpcError
+from vearch_tpu.obs import accounting
 
 SPACE_CACHE_TTL = 3.0
 
@@ -269,6 +270,19 @@ class RouterServer:
             "included) — the replica-routing decision audit",
             ("node",), _route_series)
 
+        # per-space SLO engine (docs/ACCOUNTING.md): objectives are
+        # declared on the Space entity and reconciled on every metadata
+        # fetch; each *logical* search observes exactly once in
+        # _h_search (hedge attempts never reach that layer, so a won
+        # hedge bills once)
+        self.slo = accounting.SpaceSLOEngine()
+        m.callback_gauge(
+            "vearch_space_slo_burn_rate",
+            "fast-window (5m) error-budget burn rate per space with a "
+            "declared SLO (sustained >= 14.4 exhausts a 30-day budget "
+            "in ~2 days and turns cluster health yellow)",
+            ("space",), self.slo.burn_gauge)
+
     def start(self) -> None:
         self.server.start()
         if self._grpc_port is not None:
@@ -375,6 +389,8 @@ class RouterServer:
 
     def _h_router_stats(self, _body, _parts) -> dict:
         now = time.monotonic()
+        # computed outside _cache_lock: the SLO engine has its own lock
+        slo = self.slo.summary()
         # merged latency view: the node-level scatter sketch plus the
         # per-partition breakdown, keyed "pid/op" for wire transport
         quant = {
@@ -406,6 +422,10 @@ class RouterServer:
                 "hedges": hedges,
                 "hedge_tokens": hedge_tokens,
                 "replica_routes": routes,
+                # per-space SLO state: objective, burn rates, latency
+                # sketch — the doctor's slo_burn check and the master's
+                # health rollup both read this block
+                "slo": slo,
             }
 
     def _h_cache_invalidate(self, body, _parts) -> dict:
@@ -556,6 +576,13 @@ class RouterServer:
             )
             canonical = f"{alias['db_name']}/{alias['space_name']}"
         space = Space.from_dict(data)
+        # SLO reconcile on every metadata fetch: declared objectives
+        # start (or stop) being scored within one cache TTL of the
+        # space definition changing. Alias users score under the alias
+        # key too, so their burn shows up under the name they query.
+        self.slo.set_objective(canonical, space.slo)
+        if canonical != key:
+            self.slo.set_objective(key, space.slo)
         # runs whether or not the fetch is cached below: the pid-set
         # diff is what retires remapped partitions from the result
         # cache, and a watch-raced fetch still carries a valid map
@@ -840,7 +867,12 @@ class RouterServer:
             try:
                 out = self._call_partition(
                     skey, pid, "/ps/doc/search",
-                    {**sub, "request_id": rid, "_hedge_attempt": att},
+                    # _hedge_extra marks the DUPLICATE attempt for the
+                    # PS accountant: its device work bills honestly but
+                    # the logical request meters once (the primary's)
+                    {**sub, "request_id": rid, "_hedge_attempt": att,
+                     **({"_hedge_extra": True} if slot == "hedge"
+                        else {})},
                     lb, exclude=exclude,
                     on_target=lambda n: box["nodes"].__setitem__(slot, n),
                 )
@@ -1307,6 +1339,7 @@ class RouterServer:
         t0 = time.monotonic()
         out: dict | None = None
         killed = False
+        slo_bad = False
         try:
             out = self._retry_moved(
                 (body["db_name"], body["space_name"]),
@@ -1316,9 +1349,19 @@ class RouterServer:
             # a killed request (deadline/slow/operator) is terminal —
             # it still must leave a slowlog record at this role
             killed = e.code == ERR_REQUEST_KILLED
+            # availability scoring: sheds, kills, and server faults
+            # spend the error budget; client errors (bad names, parse
+            # failures) do not
+            slo_bad = e.code in (429, ERR_REQUEST_KILLED) or e.code >= 500
             raise
         finally:
             ms = (time.monotonic() - t0) * 1e3
+            # one observation per logical request — the hedged second
+            # attempt lives below this layer, so a won hedge scores
+            # (and bills) exactly once
+            self.slo.observe(
+                f"{body.get('db_name')}/{body.get('space_name')}",
+                ms, ok=not slo_bad)
             if self.slowlog.should_log(ms, killed=killed):
                 entry = {
                     "op": "search",

@@ -42,6 +42,7 @@ from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
+from vearch_tpu.obs import accounting as _acct
 from vearch_tpu.obs import flight_recorder as _flightrec
 from vearch_tpu.ops import perf_model
 from vearch_tpu.tools import lockcheck
@@ -52,7 +53,7 @@ if TYPE_CHECKING:  # pragma: no cover
 
 class _Pending:
     __slots__ = ("req", "rows", "done", "results", "error", "t_enqueue",
-                 "trace_id")
+                 "trace_id", "space")
 
     def __init__(self, req: "SearchRequest", rows: int):
         self.req = req
@@ -70,6 +71,10 @@ class _Pending:
         # a serving-path compile lands in /debug/compiles carrying the
         # trace of the request that forced it
         self.trace_id = _flightrec.current_trace()
+        # cost attribution crosses the hop the same way: the dispatcher
+        # re-binds the space around the device call (dispatch/H2D
+        # observers fire there) and apportions the bucket's device time
+        self.space = _acct.current_space()
 
 
 def _note_queue_wait(p: "_Pending", t_dequeue: float) -> None:
@@ -303,15 +308,26 @@ class BatchScheduler:
         self.dispatch_capacity += min(
             perf_model.bucket_rows(rows), max(self.max_rows, rows)
         )
+        for p in group:
+            _acct.ACCOUNTANT.charge(
+                "queue_wait_us",
+                int(max(0.0, t_dequeue - p.t_enqueue) * 1e6),
+                space=p.space)
         if len(group) == 1:
             p = group[0]
             tok = _flightrec.set_active_trace(p.trace_id)
+            stok = _acct.set_space(p.space)
+            t_run0 = time.monotonic()
             try:
                 _note_queue_wait(p, t_dequeue)
                 p.results = self.engine._search_direct(p.req)
             except Exception as e:
                 p.error = e
             finally:
+                _acct.ACCOUNTANT.charge(
+                    "device_us", int((time.monotonic() - t_run0) * 1e6),
+                    space=p.space)
+                _acct.reset_space(stok)
                 _flightrec.reset_active_trace(tok)
                 p.done.set()
             return
@@ -351,11 +367,21 @@ class BatchScheduler:
             )
             t_pack1 = time.monotonic()
             # a combined dispatch has many originators; attribute any
-            # compile to the head — one real trace beats none
+            # compile to the head — one real trace beats none. Discrete
+            # dispatch/H2D events bill to the head's space (they cannot
+            # be split); the measured device wall slice below IS split,
+            # by row share, so shared-bucket device time stays
+            # conservation-exact per tenant.
             tok = _flightrec.set_active_trace(group[0].trace_id)
+            stok = _acct.set_space(group[0].space)
+            t_run0 = time.monotonic()
             try:
                 results = self.engine._search_direct(big)
             finally:
+                _acct.ACCOUNTANT.apportion_device_us(
+                    [(p.space, p.rows) for p in group],
+                    int((time.monotonic() - t_run0) * 1e6))
+                _acct.reset_space(stok)
                 _flightrec.reset_active_trace(tok)
             if trace is not None:
                 # pack span: host-side group assembly ahead of the
@@ -372,6 +398,8 @@ class BatchScheduler:
             # instead of a full-cost re-run (same as the success path).
             for p in group:
                 tok = _flightrec.set_active_trace(p.trace_id)
+                stok = _acct.set_space(p.space)
+                t_run0 = time.monotonic()
                 try:
                     if p.req.ctx is not None and p.req.ctx.killed:
                         p.error = RequestKilled(
@@ -381,6 +409,11 @@ class BatchScheduler:
                 except Exception as e:
                     p.error = e
                 finally:
+                    _acct.ACCOUNTANT.charge(
+                        "device_us",
+                        int((time.monotonic() - t_run0) * 1e6),
+                        space=p.space)
+                    _acct.reset_space(stok)
                     _flightrec.reset_active_trace(tok)
                     p.done.set()
             return

@@ -28,6 +28,7 @@ from typing import Any
 import numpy as np
 
 from vearch_tpu.engine.bitmap import BitmapManager
+from vearch_tpu.obs import accounting as _acct
 from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.engine.table import Table
 from vearch_tpu.engine.types import (
@@ -1026,7 +1027,14 @@ class Engine:
                         )
             if mb is not None:
                 return mb.submit(req)
-        return self._search_direct(req)
+        # direct path: the whole engine wall slice bills to the bound
+        # space (the scheduler path apportions inside _run_bucket)
+        t0 = time.monotonic()
+        try:
+            return self._search_direct(req)
+        finally:
+            _acct.ACCOUNTANT.charge(
+                "device_us", int((time.monotonic() - t0) * 1e6))
 
     def _filtered_mask(self, filters: Any, n: int) -> np.ndarray:
         """Alive∧filter mask for the first `n` rows, cached on

@@ -12,6 +12,10 @@ invariant checks:
 - ``cardinality_ceiling``  every /metrics page stays under the series
                            ceiling the cardinality soak enforces in CI
 - ``cluster_health``       the master rollup is not red
+- ``slo_burn``             no space with a declared SLO is fast-burning
+                           its error budget (router burn-rate windows)
+- ``usage_conservation``   every PS's per-tenant meters sum exactly to
+                           its accountant totals (docs/ACCOUNTING.md)
 - ``obs_docs``             docs/OBSERVABILITY.md matches the source
                            (skipped when no source tree is present)
 
@@ -272,6 +276,53 @@ def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
                    else (f"{judged} partition(s) under 50% padding waste"
                          if judged
                          else "no bucketed traffic to judge")),
+    })
+
+    # per-space SLO burn: a space whose fast (5-minute) window burns
+    # its declared error budget at page rate is a tenant-visible
+    # incident — the check names the space and its burn multiple so
+    # the operator knows WHO is out of budget, not just that someone is
+    burning = []
+    scored = 0
+    for rt in report.get("routers", []):
+        slo = ((rt.get("stats") or {}).get("slo") or {})
+        for space, rec in slo.items():
+            if not isinstance(rec, dict):
+                continue
+            scored += 1
+            if rec.get("fast_burn"):
+                burning.append(
+                    f"{space} burning {rec.get('burn_fast')}x its error "
+                    f"budget (router {rt.get('addr')}, "
+                    f"objective {rec.get('objective')})"
+                )
+    checks.append({
+        "name": "slo_burn", "ok": not burning,
+        "detail": ("; ".join(burning) if burning
+                   else (f"{scored} declared SLO(s) inside budget"
+                         if scored else "no spaces declare an SLO")),
+    })
+
+    # per-tenant meter conservation: the accountant increments space
+    # and total under one lock, so any mismatch is a billing bug, not
+    # load noise — exact equality is the contract
+    leaks = []
+    for srv in report.get("servers", []):
+        usage = (srv.get("stats") or {}).get("usage") or {}
+        totals = usage.get("totals") or {}
+        spaces_u = usage.get("spaces") or {}
+        for meter, total in totals.items():
+            summed = sum(int((m or {}).get(meter, 0))
+                         for m in spaces_u.values())
+            if summed != int(total):
+                leaks.append(
+                    f"node {srv.get('node_id')} meter {meter}: "
+                    f"sum(spaces)={summed} != total={total}"
+                )
+    checks.append({
+        "name": "usage_conservation", "ok": not leaks,
+        "detail": ("; ".join(leaks) if leaks
+                   else "per-space meters reconcile to totals exactly"),
     })
 
     try:

@@ -55,6 +55,20 @@ def set_dispatch_ledger(ledger: list | None) -> None:
     _dispatch_ledger = ledger
 
 
+# Optional dispatch observer (obs/accounting installs one): called as
+# observer(tag) from the SAME note_dispatch call that feeds the ledger
+# and the per-request capture, so per-tenant dispatch counts reconcile
+# with the global ledger exactly — same single-slot contract as
+# perf_model.set_compile_observer.
+_dispatch_observer = None
+
+
+def set_dispatch_observer(fn) -> None:
+    """Install (or clear, with None) the process-wide dispatch observer."""
+    global _dispatch_observer
+    _dispatch_observer = fn
+
+
 # Per-request dispatch capture (observability tentpole): a thread-local
 # recorder layered on top of the process-global ledger. The engine
 # installs one per search so the profile/trace surface can report which
@@ -124,6 +138,9 @@ def end_capture() -> DispatchCapture | None:
 def note_dispatch(tag: str) -> None:
     if _dispatch_ledger is not None:
         _dispatch_ledger.append(tag)
+    obs = _dispatch_observer
+    if obs is not None:
+        obs(tag)
     cap = getattr(_capture_tls, "capture", None)
     if cap is not None:
         cap.note(tag)

@@ -348,12 +348,27 @@ def total_compiled_programs() -> int:
 _h2d_lock = threading.Lock()
 _h2d_bytes_total = 0
 
+# Optional H2D observer (obs/accounting installs one): called with the
+# byte count from the SAME note_h2d_bytes call that feeds the process
+# total, so per-tenant byte meters reconcile with h2d_bytes_total
+# exactly — same single-slot contract as set_compile_observer.
+_h2d_observer: Any = None
+
+
+def set_h2d_observer(fn: Any) -> None:
+    """Install (or clear, with None) the process-wide H2D byte observer."""
+    global _h2d_observer
+    _h2d_observer = fn
+
 
 def note_h2d_bytes(n: int) -> None:
     """Record `n` bytes copied host->device (call at device_put sites)."""
     global _h2d_bytes_total
     with _h2d_lock:
         _h2d_bytes_total += int(n)
+    obs = _h2d_observer
+    if obs is not None:
+        obs(int(n))
 
 
 def h2d_bytes_total() -> int:

@@ -34,7 +34,8 @@ def main(argv: list[str] | None = None) -> int:
     # importing run_paths' rule modules happens inside run_paths; for
     # --list-rules force it eagerly
     from vearch_tpu.tools.lint import (  # noqa: F401
-        rules_buckets, rules_dispatch, rules_errors, rules_locks, rules_obs,
+        rules_accounting, rules_buckets, rules_dispatch, rules_errors,
+        rules_locks, rules_obs,
     )
 
     if args.list_rules:

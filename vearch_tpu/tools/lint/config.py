@@ -81,6 +81,20 @@ BUCKET_FETCH_K_TIERS = (16, 64, 256, 1024)
 # module-level names matched (by suffix) as shape-tier declarations
 BUCKET_NAME_SUFFIXES = ("_BUCKETS", "_TIERS")
 
+# -- VL104 tenant attribution -------------------------------------------------
+# Serving-path files where billable counter mutations must carry space
+# attribution (docs/ACCOUNTING.md): ISSUE 17 made every serving-path
+# cost tenant-attributable, and a new .inc() that forgets the space
+# label silently un-attributes a whole failure class. Matched by path
+# suffix, like SERVING_PATH_FUNCTIONS.
+VL104_SERVING_FILES = (
+    "vearch_tpu/cluster/ps.py",
+    "vearch_tpu/cluster/router.py",
+)
+# counter attributes whose .inc() calls are billable events: they count
+# per-tenant failures (kills, sheds) and must pass a space label
+VL104_BILLABLE_COUNTERS = ("_killed_total", "_shed_total")
+
 # -- VL201 lock discipline ----------------------------------------------------
 # Methods treated as mutations when called on a guarded attribute.
 MUTATOR_METHODS = {

@@ -229,8 +229,8 @@ def run_paths(
     suppressed ones marked (callers filter on `.suppressed`)."""
     # import for side effect: rule registration
     from vearch_tpu.tools.lint import (  # noqa: F401
-        rules_buckets, rules_dispatch, rules_errors, rules_locks,
-        rules_obs,
+        rules_accounting, rules_buckets, rules_dispatch, rules_errors,
+        rules_locks, rules_obs,
     )
 
     active = list(rules) if rules is not None else list(RULES)
