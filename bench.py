@@ -628,6 +628,132 @@ def continuous_batching_bench(dry: bool) -> dict:
     }
 
 
+def search_quality_bench(dry: bool) -> dict:
+    """Search-quality truth layer (docs/QUALITY.md): grounded
+    recall@10/@100 for each approximate index family against an exact
+    scan of the same corpus, plus the serving cost of the shadow
+    sampler — the same query stream with sampling off vs wide open
+    (rate 1.0: every query queued, exact-reranked and scored through
+    QualityMonitor, drained inline so the worst-case cost is charged
+    to the stream). The monitor's own streaming estimate is reported
+    next to the offline number it is supposed to track."""
+    from vearch_tpu.engine.engine import Engine, SearchRequest
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+    from vearch_tpu.obs.quality import QualityMonitor
+
+    d = 32
+    n, nq, nc = (4_000, 16, 32) if dry else (100_000, 64, 512)
+    rng = np.random.default_rng(13)
+    base = rng.standard_normal((n, d)).astype(np.float32)
+    queries = (base[rng.choice(n, nq, replace=False)]
+               + 0.05 * rng.standard_normal((nq, d)).astype(np.float32))
+    # exact L2 ground truth to depth 100, f64 so ties don't flap
+    d2 = ((base.astype(np.float64) ** 2).sum(1)[None, :]
+          - 2.0 * queries.astype(np.float64) @ base.astype(np.float64).T)
+    gt = np.argsort(d2, axis=1, kind="stable")[:, :100]
+
+    families = {
+        "FLAT": ("FLAT", {}),
+        "IVFPQ_int8": ("IVFPQ", {"ncentroids": nc, "nsubvector": 8,
+                                 "nprobe": max(nc // 8, 8)}),
+        "SCANN": ("SCANN", {"ncentroids": nc, "nsubvector": 8,
+                            "nprobe": max(nc // 8, 8)}),
+        "DISKANN": ("DISKANN", {"ncentroids": nc,
+                                "nprobe": max(nc // 8, 8),
+                                "cache_mb": 64, "ram_mb": 64}),
+    }
+    rerank = {"IVFPQ_int8": {"rerank": 128}, "SCANN": {"rerank": 128}}
+
+    def build(itype, params):
+        schema = TableSchema("q", [
+            FieldSchema("v", DataType.VECTOR, dimension=d,
+                        index=IndexParams(itype, MetricType.L2,
+                                          {**params,
+                                           "training_threshold": n})),
+        ])
+        eng = Engine(schema)
+        for i in range(0, n, 20_000):
+            eng.upsert([{"_id": str(j), "v": base[j]}
+                        for j in range(i, min(i + 20_000, n))])
+        eng.build_index()
+        return eng
+
+    def recall_at(eng, k, sp):
+        res = eng.search(SearchRequest(vectors={"v": queries}, k=k,
+                                       include_fields=[],
+                                       index_params=sp))
+        got = [[int(it.key) for it in r.items] for r in res]
+        return float(np.mean([
+            len(set(got[q]) & set(gt[q, :k].tolist())) / k
+            for q in range(nq)
+        ]))
+
+    out = {"n": n, "d": d, "recall": {}}
+    serving = None
+    for name, (itype, params) in families.items():
+        try:
+            eng = build(itype, params)
+        except Exception as e:  # one family must not sink the phase
+            out["recall"][name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        sp = rerank.get(name, {})
+        out["recall"][name] = {
+            "at_10": round(recall_at(eng, 10, sp), 4),
+            "at_100": round(recall_at(eng, 100, sp), 4),
+        }
+        if name == "IVFPQ_int8":
+            serving = eng  # shadow-overhead subject below
+        else:
+            eng.close()
+    if serving is None:
+        return out
+
+    # shadow overhead: the same stream, sampler off vs rate 1.0 with an
+    # inline drain after every search (production runs the drain on the
+    # worker thread; inline is the upper bound)
+    mon = QualityMonitor(get_engines=lambda: {1: serving},
+                         pid_space=lambda pid: "bench/q",
+                         sample_rate=1.0, min_samples=1)
+    sp = rerank["IVFPQ_int8"]
+    reps = 3 if dry else 10
+
+    def stream(shadow: bool) -> float:
+        t0 = time.time()
+        for _ in range(reps):
+            for i in range(nq):
+                q = queries[i:i + 1]
+                res = serving.search(SearchRequest(
+                    vectors={"v": q}, k=10, include_fields=[],
+                    index_params=sp))
+                if shadow:
+                    mon.observe_search(
+                        1, "bench/q", {"v": q}, 10, res,
+                        int(serving.data_version), index_params=sp)
+                    mon.run_pending()
+        return reps * nq / (time.time() - t0)
+
+    stream(True)  # warm both program families (serve + exact shadow)
+    qps_off = stream(False)
+    qps_on = stream(True)
+    snap = mon.recall_snapshot()["spaces"].get("bench/q", {})
+    est = (snap.get("recall") or {}).get("10") or {}
+    out["shadow"] = {
+        "sample_rate": 1.0,
+        "qps_shadow_off": round(qps_off, 1),
+        "qps_shadow_on": round(qps_on, 1),
+        "overhead_pct": round(100.0 * (1.0 - qps_on / qps_off), 1)
+        if qps_off else 0.0,
+        "executed": mon.counters().get("executed", 0),
+        "estimator_recall_at_10": round(est["estimate"], 4)
+        if est.get("estimate") is not None else None,
+        "offline_recall_at_10": out["recall"]["IVFPQ_int8"]["at_10"],
+    }
+    serving.close()
+    return out
+
+
 def main():
     if _dryrun():
         import jax as _jax
@@ -858,6 +984,19 @@ def main():
     else:
         emit("continuous_batching_resumed", **cb_diag)
 
+    # -- search quality (quality-truth tentpole): grounded recall@10/
+    # @100 per index family vs exact, plus shadow-sampler overhead at
+    # rate 1.0. Resumable like the tail phase; never kills the headline.
+    quality_diag = _phase_cached(partial_path, "quality")
+    if quality_diag is None:
+        try:
+            quality_diag = search_quality_bench(_dryrun())
+        except Exception as e:
+            quality_diag = {"error": f"{type(e).__name__}: {e}"}
+        emit("quality", **quality_diag)
+    else:
+        emit("quality_resumed", **quality_diag)
+
     # -- per-phase breakdown (r4 review next-1: the captured headline
     # must be decomposable — where does the wall time go?) ------------
     from vearch_tpu.ops import ivf as ivf_ops
@@ -1063,6 +1202,7 @@ def main():
         "cache": cache_diag,
         "tail_latency": tail_diag,
         "tiered_storage": tier_diag,
+        "quality": quality_diag,
         **glove_diag,
         **cpu_diag,
         f"latency_ms_b{batch}": round(dt * 1e3, 1),

@@ -58,8 +58,8 @@ def test_list_rules_names_every_rule():
         capture_output=True, text=True, cwd=REPO,
     )
     assert out.returncode == 0
-    for rid in ("VL101", "VL102", "VL103", "VL104", "VL201", "VL202",
-                "VL203", "VL301", "VL302", "VL401"):
+    for rid in ("VL101", "VL102", "VL103", "VL104", "VL105", "VL201",
+                "VL202", "VL203", "VL301", "VL302", "VL401"):
         assert rid in out.stdout, rid
 
 
@@ -232,6 +232,51 @@ def test_vl104_inline_allow_and_other_files_pass(tmp_path):
         class Master:
             def note(self):
                 self._shed_total.inc("search")
+        """)
+    assert found == []
+
+
+def test_vl105_index_mutation_without_staleness_hook_fires(tmp_path):
+    """A PS path that rebuilds an index without telling the quality
+    monitor leaves shadow recall scoring fresh truth against the old
+    serving snapshot — VL105."""
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        class PSServer:
+            def _run_build(self, pid, rebuild):
+                eng = self.engines[pid]
+                if rebuild:
+                    eng.rebuild_index()
+                else:
+                    eng.build_index()
+        """)
+    assert _rules(found) == ["VL105"]
+    assert len(found) == 1
+    assert "note_index_mutation" in found[0].message
+
+
+def test_vl105_hook_call_satisfies(tmp_path):
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        class PSServer:
+            def _run_build(self, pid):
+                self.engines[pid].build_index()
+                self._quality.note_index_mutation(pid, "db/s", op="build")
+        """)
+    assert found == []
+
+
+def test_vl105_other_files_out_of_scope_and_allow_waives(tmp_path):
+    """Engine-internal build paths are out of scope (the engine calls
+    the PS observer); a justified def-line pragma waives in scope."""
+    found = _lint_file(tmp_path, "vearch_tpu/engine/engine.py", """\
+        class Engine:
+            def absorb(self):
+                self.index.build_index()
+        """)
+    assert found == []
+    found = _lint_file(tmp_path, "vearch_tpu/cluster/ps.py", """\
+        class PSServer:
+            def _warm(self, eng):  # lint: allow[quality-staleness] offline warmup engine, never serves
+                eng.build_index()
         """)
     assert found == []
 

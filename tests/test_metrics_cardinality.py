@@ -86,6 +86,19 @@ def test_profiled_soak_does_not_grow_series(cluster, rng):
                 "vearch_ps_queue_depth",
                 "vearch_ps_inflight",
                 "vearch_ps_admission_shed_total"} <= names, names
+        # the search-quality truth layer is callback-rendered from the
+        # same fixed topology: recall/rbo/breach by (k-tier x space
+        # label), shadow pipeline by its closed event set, index health
+        # by partition — all present (zero-filled) before any sampling
+        assert {"vearch_ps_search_recall",
+                "vearch_ps_search_rbo",
+                "vearch_ps_search_recall_floor_breach",
+                "vearch_ps_quality_shadow_total",
+                "vearch_ps_index_health_recon_error",
+                "vearch_ps_index_health_cell_imbalance",
+                "vearch_ps_index_health_deleted_frac",
+                "vearch_ps_index_health_unindexed_frac",
+                "vearch_ps_index_health_needs_retrain"} <= names, names
     rnames = {s.split("{")[0] for s in baseline[cluster.router_addr]}
     # tail-latency series are pre-initialized (hedge events zero-filled,
     # per-node routes zero-filled at discovery): traffic, hedges and
@@ -306,4 +319,89 @@ def test_profiled_write_soak_does_not_grow_series(cluster, rng):
         assert "trace_id=" not in text
         for line in text.splitlines():
             assert not re.search(r'="w\d{1,4}"', line), line
+        assert len(_series(text)) <= SERIES_CEILING, addr
+
+
+def test_quality_shadow_soak_does_not_grow_series(cluster, rng):
+    """Quality-layer mirror of the search soak: with shadow sampling
+    wide open (rate 1.0) every query is queued, ground-truthed and
+    scored — and none of it may mint a series. Recall/rbo/breach render
+    (k-tier x space-label), the shadow counter renders its closed event
+    tuple, and index health renders per partition: topology and fixed
+    enums only, never per-query."""
+    import time
+
+    from vearch_tpu.obs.quality import SHADOW_EVENTS
+    from vearch_tpu.ops.perf_model import RECALL_K_TIERS
+
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 2,
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    vecs = rng.standard_normal((100, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(100)])
+    for ps in cluster.ps_nodes:
+        ps._quality.configure(sample_rate=1.0, min_samples=1)
+
+    def search(qs: np.ndarray) -> None:
+        out = rpc.call(cluster.router_addr, "POST", "/document/search", {
+            "db_name": "db", "space_name": "s",
+            "vectors": [{"field": "v", "feature": q.tolist()} for q in qs],
+            "limit": 5,
+        })
+        assert out["documents"]
+
+    def executed() -> int:
+        return sum(ps._quality.counters().get("executed", 0)
+                   for ps in cluster.ps_nodes)
+
+    addrs = [ps.addr for ps in cluster.ps_nodes]
+
+    # warm: first shadow per node compiles the exact path and scores at
+    # least once, so estimator-backed values exist before the baseline
+    search(vecs[:BATCH])
+    deadline = time.monotonic() + 30.0
+    while executed() < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert executed() >= 1, "no shadow sample ever scored"
+    baseline = {a: _series(scrape(a)) for a in addrs}
+
+    for addr in addrs:
+        text = scrape(addr)
+        # the shadow counter's label set is the closed event enum,
+        # fully zero-filled from the first scrape
+        events = set(re.findall(
+            r'vearch_ps_quality_shadow_total\{event="([^"]+)"\}', text))
+        assert events == set(SHADOW_EVENTS), events
+        # recall series = k-tiers x space labels (top-K policy + other),
+        # never per-query; both hosted spaces collapse to one label here
+        recalls = re.findall(
+            r'vearch_ps_search_recall\{k="(\d+)",space="([^"]+)"\}', text)
+        assert {k for k, _ in recalls} == {str(t) for t in RECALL_K_TIERS}
+        assert len(recalls) <= len(RECALL_K_TIERS) * (len(recalls) and
+                                                      len({s for _, s in
+                                                           recalls}))
+
+    done = BATCH
+    while done < N_QUERIES // 2:
+        search(vecs[rng.integers(0, 100, size=BATCH)])
+        done += BATCH
+    # let the background worker drain what it will; whatever executed,
+    # shed or went stale moves VALUES only
+    deadline = time.monotonic() + 30.0
+    while executed() < 20 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert executed() >= 20, "soak shadows never drained"
+
+    for addr in addrs:
+        text = scrape(addr)
+        grown = _series(text) - baseline[addr]
+        assert not grown, f"{addr}: quality soak minted series: {grown}"
+        for line in text.splitlines():  # docids never become labels
+            assert not re.search(r'="d\d{1,3}"', line), line
         assert len(_series(text)) <= SERIES_CEILING, addr

@@ -1,0 +1,514 @@
+"""Search-quality truth layer gates (observability tentpole).
+
+Three acceptance families (docs/QUALITY.md):
+
+1. **Corruption → breach spine (e2e).** A planted quantizer corruption
+   (scrambled int8 mirror + noised PQ codebooks) drives shadow-sampled
+   recall under the space's declared floor; the breach is visible at
+   every hop — /ps/stats quality block, heartbeat obs → master,
+   /cluster/health yellow naming the space, `doctor` exit 1 — and
+   CLEARS after a rebuild retrains the quantizers (the VL105 staleness
+   hook resets the estimators).
+2. **Perf + accounting gate.** The shadow path adds ZERO new compiled
+   programs after its first warm-scoped execution, launches only the
+   documented FLAT dispatch, and bills every shadow to the reserved
+   ``__quality__`` space with exact meter conservation.
+3. **Deterministic sampling.** Row selection is a pure function of
+   (seed, query bytes, k): replicas agree, reruns reproduce.
+
+Plus the tiering read-ahead gate the ROADMAP carried: the madvise
+gather path is page-cache-only — the warm-path H2D byte ledger stays
+exactly zero (tiering/readahead.py docstring contract).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from vearch_tpu.cluster import rpc
+from vearch_tpu.cluster.standalone import StandaloneCluster
+from vearch_tpu.engine.engine import Engine, SearchRequest
+from vearch_tpu.engine.types import (
+    DataType,
+    FieldSchema,
+    IndexParams,
+    MetricType,
+    TableSchema,
+)
+from vearch_tpu.obs import accounting, flight_recorder
+from vearch_tpu.obs.accounting import ACCOUNTANT, METERS, QUALITY_SPACE
+from vearch_tpu.obs.quality import (
+    QualityMonitor,
+    rank_biased_overlap,
+    wilson_bounds,
+)
+from vearch_tpu.ops import ivf as ivf_ops
+from vearch_tpu.ops import perf_model
+from vearch_tpu.sdk.client import VearchClient
+
+D = 16
+FLOOR = 0.8
+
+
+def _poll(cond, timeout_s: float, interval_s: float = 0.1):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if cond():
+            return True
+        if time.monotonic() >= deadline:
+            return cond()
+        time.sleep(interval_s)
+
+
+# -- 3. deterministic sampling ------------------------------------------------
+
+
+def test_sampling_is_deterministic_across_instances():
+    """Same (seed, row, k) → same verdict on every monitor: replicas
+    serving identical traffic shadow the identical subset, and a rerun
+    reproduces the original sample exactly."""
+    rng = np.random.default_rng(7)
+    rows = rng.standard_normal((400, D)).astype(np.float32)
+    a = QualityMonitor(sample_rate=0.1, seed=3)
+    b = QualityMonitor(sample_rate=0.1, seed=3)
+    picks_a = [a.sampled(r, 10) for r in rows]
+    picks_b = [b.sampled(r, 10) for r in rows]
+    assert picks_a == picks_b
+    # the rate is honored statistically (keyed blake2b is uniform)
+    frac = sum(picks_a) / len(picks_a)
+    assert 0.03 < frac < 0.2, frac
+    # a different seed keys a different hash → a different subset
+    c = QualityMonitor(sample_rate=0.1, seed=4)
+    assert [c.sampled(r, 10) for r in rows] != picks_a
+    # k participates in the key: the same row at a different k is an
+    # independent draw, not a correlated one
+    assert [a.sampled(r, 100) for r in rows] != picks_a
+    # boundary rates short-circuit correctly
+    z = QualityMonitor(sample_rate=0.0)
+    assert not any(z.sampled(r, 10) for r in rows[:50])
+    f = QualityMonitor(sample_rate=1.0)
+    assert all(f.sampled(r, 10) for r in rows[:50])
+
+
+def test_observe_search_enqueues_exactly_the_sampled_rows():
+    rng = np.random.default_rng(8)
+    batch = rng.standard_normal((64, D)).astype(np.float32)
+    mon = QualityMonitor(sample_rate=0.25, seed=1)
+    expect = sum(mon.sampled(batch[i], 10) for i in range(64))
+    served = [[f"d{i}"] for i in range(64)]
+    picked = mon.observe_search(1, "db/s", {"v": batch}, 10, served,
+                                data_version=1)
+    assert picked == expect > 0
+    assert mon.counters()["sampled"] == expect
+    # rerun on a fresh monitor with the same seed: identical queue
+    mon2 = QualityMonitor(sample_rate=0.25, seed=1)
+    assert mon2.observe_search(1, "db/s", {"v": batch}, 10, served,
+                               data_version=1) == expect
+
+
+def test_estimator_math_sanity():
+    lo, hi = wilson_bounds(90, 100)
+    assert 0.82 < lo < 0.9 < hi < 0.96
+    assert wilson_bounds(0, 0) == (0.0, 1.0)
+    assert rank_biased_overlap(["a", "b"], ["a", "b"]) == pytest.approx(1.0)
+    assert rank_biased_overlap(["a", "b"], ["x", "y"]) == pytest.approx(0.0)
+    # top-heavy: agreeing at rank 1 outweighs agreeing at rank 2
+    top = rank_biased_overlap(["a", "x"], ["a", "y"])
+    tail = rank_biased_overlap(["x", "b"], ["y", "b"])
+    assert top > tail
+
+
+def test_stale_data_version_drops_sample_instead_of_scoring():
+    """Docs written between serve and shadow change the corpus: scoring
+    the old served list against fresh truth would report phantom recall
+    loss — the job is dropped as `stale`, never scored."""
+    schema = TableSchema("t", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    rng = np.random.default_rng(9)
+    vecs = rng.standard_normal((50, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "v": vecs[i]} for i in range(50)])
+    mon = QualityMonitor(get_engines=lambda: {1: eng},
+                         sample_rate=1.0)
+    mon.observe_search(1, "db/s", {"v": vecs[0]}, 5, [["d0"]],
+                       data_version=int(eng.data_version))
+    eng.upsert([{"_id": "w", "v": vecs[1]}])  # corpus moved
+    assert mon.run_pending() == 1
+    cnt = mon.counters()
+    assert cnt["stale"] == 1 and cnt["executed"] == 0
+    eng.close()
+
+
+# -- 2. perf + accounting gate ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def flat_engine():
+    schema = TableSchema("t", [
+        FieldSchema("emb", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    rng = np.random.default_rng(21)
+    vecs = rng.standard_normal((500, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i:04d}", "emb": vecs[i]} for i in range(500)])
+    eng.build_index()
+    eng.wait_for_index()
+    yield eng, vecs
+    eng.close()
+
+
+def test_shadow_zero_new_programs_and_documented_dispatches(flat_engine):
+    """The perf gate the tentpole hinges on: after the first warm-scoped
+    ground-truth run, repeated shadows add ZERO compiled programs and
+    launch exactly the documented FLAT dispatch — shadow sampling can
+    never become a retrace source on a serving node."""
+    eng, vecs = flat_engine
+    flight_recorder.install()
+    mon = QualityMonitor(get_engines=lambda: {1: eng}, sample_rate=1.0)
+
+    def shadow(i):
+        res = eng.search(SearchRequest(
+            vectors={"emb": vecs[i][None, :]}, k=10, include_fields=[]))
+        served = [[it.key for it in res[0].items]]
+        mon.observe_search(1, "db/q", {"emb": vecs[i]}, 10, served,
+                           data_version=int(eng.data_version))
+        return mon.run_pending()
+
+    assert shadow(0) == 1  # cold: compile lands in the warmup scope
+    flight_recorder.RECORDER.reset()
+    before = perf_model.total_compiled_programs()
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        for i in range(1, 6):
+            assert shadow(i) == 1
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    assert perf_model.total_compiled_programs() == before, (
+        "warm shadow executions grew the jit cache — the ground-truth "
+        "path retraces per request")
+    assert flight_recorder.RECORDER.counts() == {}, (
+        "shadow execution recorded a post-warmup serving compile")
+    # each round = one serving search + one shadow truth; both are the
+    # documented flat_scan — nothing undocumented launched
+    doc = perf_model.DOCUMENTED_DISPATCHES["flat"]
+    assert ledger.tags == doc * 10, ledger.tags
+
+
+def test_shadow_bills_quality_space_with_exact_conservation(flat_engine):
+    eng, vecs = flat_engine
+    accounting.install()
+    mon = QualityMonitor(get_engines=lambda: {1: eng}, sample_rate=1.0)
+    snap0 = ACCOUNTANT.snapshot()
+    for i in range(10, 14):
+        res = eng.search(SearchRequest(
+            vectors={"emb": vecs[i][None, :]}, k=10, include_fields=[]))
+        mon.observe_search(1, "db/q", {"emb": vecs[i]}, 10,
+                           [[it.key for it in res[0].items]],
+                           data_version=int(eng.data_version))
+    assert mon.run_pending() == 4
+    snap1 = ACCOUNTANT.snapshot()
+    q0 = snap0["spaces"].get(QUALITY_SPACE, {})
+    q1 = snap1["spaces"].get(QUALITY_SPACE, {})
+    assert q1.get("dispatches", 0) - q0.get("dispatches", 0) == 4, (
+        "each shadow ground truth must bill exactly one dispatch to "
+        f"{QUALITY_SPACE}")
+    assert q1.get("device_us", 0) > q0.get("device_us", 0)
+    # conservation holds with the reserved space in the ledger:
+    # sum(spaces) == totals for every meter — shadow work is charged
+    # once, to __quality__, and never leaks into tenant meters
+    for meter in METERS:
+        total = snap1["totals"][meter]
+        by_space = sum(m[meter] for m in snap1["spaces"].values())
+        assert by_space == total, (
+            f"{meter}: sum(spaces)={by_space} != total={total}")
+
+
+def test_shed_shadow_is_counted_not_executed(flat_engine):
+    """Negative-priority admission: when the node is loaded the shadow
+    sheds silently — serving traffic always wins."""
+    eng, vecs = flat_engine
+
+    class Full:
+        def try_admit(self, priority=0):
+            assert priority < 0, "shadow must admit at negative priority"
+            return False
+
+        def leave(self):  # pragma: no cover - never admitted
+            raise AssertionError("leave() without admit")
+
+    mon = QualityMonitor(get_engines=lambda: {1: eng}, sample_rate=1.0,
+                         admission=Full())
+    mon.observe_search(1, "db/q", {"emb": vecs[20]}, 10, [["d0020"]],
+                       data_version=int(eng.data_version))
+    assert mon.run_pending() == 1
+    cnt = mon.counters()
+    assert cnt["shed"] == 1 and cnt["executed"] == 0
+
+
+# -- index-health drift (unit) ------------------------------------------------
+
+
+def test_health_drift_deleted_fraction_and_retrain_reset():
+    schema = TableSchema("t", [
+        FieldSchema("v", DataType.VECTOR, dimension=D,
+                    index=IndexParams("FLAT", MetricType.L2, {})),
+    ])
+    eng = Engine(schema)
+    rng = np.random.default_rng(13)
+    vecs = rng.standard_normal((60, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i}", "v": vecs[i]} for i in range(60)])
+    eng.delete([f"d{i}" for i in range(40)])
+    mon = QualityMonitor(get_engines=lambda: {7: eng},
+                         deleted_frac_max=0.3)
+    health = mon.collect_health()
+    assert health[7]["needs_retrain"]
+    assert any("deleted_frac" in r for r in health[7]["reasons"])
+    assert mon.partition_stats(7)["needs_retrain"]
+    assert mon.obs_summary()["needs_retrain_pids"] == [7]
+    # the staleness hook drops cached health for the partition (it will
+    # be re-measured on the next cadence, post-mutation)
+    mon.note_index_mutation(7, "db/s", op="rebuild")
+    assert mon.partition_stats(7) is None
+    eng.close()
+
+
+def test_elastic_plan_surfaces_needs_retrain():
+    """The drift verdict rides heartbeat partition stats into the
+    rebalance planner next to moves/splits (cluster/elastic.py)."""
+    from vearch_tpu.cluster.elastic import compute_plan
+    from vearch_tpu.cluster.entities import Partition, Server, Space
+    from vearch_tpu.engine.types import TableSchema as TS
+
+    sp = Space(id=1, name="s", db_name="db", schema=TS("s", fields=[]),
+               partitions=[Partition(id=5, space_id=1, db_name="db",
+                                     space_name="s", slot=0,
+                                     replicas=[1], leader=1)])
+    stats = {1: {"5": {"size_bytes": 10, "quality": {
+        "needs_retrain": True,
+        "reasons": ["v: recon_error=0.9 is 3.00x train-time 0.3"],
+    }}}}
+    plan = compute_plan([sp], [Server(node_id=1, rpc_addr="x")], stats)
+    assert plan["needs_retrain"] == [{
+        "partition_id": 5, "db_name": "db", "space_name": "s",
+        "reasons": ["v: recon_error=0.9 is 3.00x train-time 0.3"],
+    }]
+
+
+# -- 1. corruption → breach spine (e2e) --------------------------------------
+
+
+IVFPQ_SPEC = {
+    "index_type": "IVFPQ", "metric_type": "L2",
+    "params": {"ncentroids": 8, "nsubvector": 4, "train_iters": 4,
+               "training_threshold": 128, "mesh_serving": "off"},
+}
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = StandaloneCluster(data_dir=str(tmp_path / "q"), n_ps=1,
+                          ps_kwargs={"heartbeat_interval": 0.3})
+    c.start()
+    yield c
+    c.stop()
+
+
+def test_corruption_breaches_floor_through_every_surface(cluster):
+    """The whole truth spine: plant quantizer corruption → shadow
+    recall sinks under the declared floor → /ps/stats → heartbeat →
+    /cluster/health yellow naming the space → doctor exit 1 → a
+    rebuild retrains and the breach CLEARS everywhere."""
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    cl.create_space("db", {
+        "name": "s", "partition_num": 1,
+        "slo": {"latency_ms": 100, "recall_floor": FLOOR},
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": IVFPQ_SPEC}],
+    })
+    rng = np.random.default_rng(5)
+    vecs = rng.standard_normal((400, D)).astype(np.float32)
+    cl.upsert("db", "s", [{"_id": f"d{i}", "v": vecs[i]}
+                          for i in range(400)])
+    ps = cluster.ps_nodes[0]
+    pid = next(iter(ps.engines))
+    rpc.call(ps.addr, "POST", "/ps/index/build", {"partition_id": pid})
+    eng = ps.engines[pid]
+    eng.wait_for_index(timeout=120)
+
+    # every search shadows; few samples needed so the test stays fast
+    ps._quality.configure(sample_rate=1.0, min_samples=10)
+    # the floor declared in Space.slo rides the register response down
+    assert _poll(lambda: ps._quality.stats()["floors"] == {"db/s": FLOOR},
+                 10.0), ps._quality.stats()["floors"]
+
+    def serve(n, start=0):
+        for i in range(start, start + n):
+            cl.search("db", "s",
+                      [{"field": "v", "feature": vecs[i % 400]}],
+                      limit=10)
+
+    # healthy phase: served recall (exact rerank) sits far above floor
+    serve(15)
+    assert _poll(
+        lambda: ps._quality.counters()["executed"] >= 15, 15.0)
+    assert ps._quality.breach_spaces() == []
+    stats = rpc.call(ps.addr, "GET", "/ps/stats")["quality"]
+    tier10 = stats["recall"]["db/s"]["recall"]["10"]
+    assert tier10["estimate"] > FLOOR
+    assert stats["recall"]["db/s"]["breach"] is False
+
+    # health baseline: first collect after train records the train-time
+    # reconstruction error the drift gauge compares against
+    base = ps._quality.collect_health()[pid]
+    recon0 = base["fields"]["v"]["recon_error"]
+    assert recon0 is not None and not base["needs_retrain"], base
+
+    # -- plant the corruption: scramble the int8 mirror (what the scan
+    # scores) and noise the PQ codebooks (what recon decodes) — the raw
+    # store stays intact, so FLAT ground truth remains exact
+    idx = eng.indexes["v"]
+    m = idx._mirror
+    n = m._n
+    perm = np.random.default_rng(0).permutation(n)
+    m._h8[:n] = m._h8[:n][perm]
+    m._h_scale[:n] = m._h_scale[:n][perm]
+    m._h_vsq[:n] = m._h_vsq[:n][perm]
+    m._d8 = None  # force re-upload of the corrupted mirror
+    import jax.numpy as jnp
+    cb = np.asarray(idx.codebooks)
+    idx.codebooks = jnp.asarray(
+        cb + np.random.default_rng(1).standard_normal(cb.shape)
+        .astype(np.float32) * 10.0 * (np.abs(cb).mean() + 1.0))
+
+    # recon drift vs the train-time baseline flags needs_retrain
+    drift = ps._quality.collect_health()[pid]
+    assert drift["needs_retrain"], drift
+    assert any("recon_error" in r for r in drift["reasons"])
+
+    # served results now come from garbage candidate scores; shadow
+    # truth is exact → the estimator sinks below the floor
+    serve(25, start=100)
+    assert _poll(lambda: ps._quality.breach_spaces() == ["db/s"], 20.0), (
+        ps._quality.recall_snapshot())
+    stats = rpc.call(ps.addr, "GET", "/ps/stats")["quality"]
+    assert stats["recall"]["db/s"]["breach"] is True
+    assert stats["recall"]["db/s"]["recall"]["10"]["estimate"] < FLOOR
+
+    # heartbeat rolls the breach + retrain hint up to the master
+    def degraded():
+        h = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+        return (h["status"] == "yellow"
+                and h.get("recall_breach_spaces") == ["db/s"]
+                and h.get("needs_retrain_partitions") == [pid])
+    assert _poll(degraded, 10.0), rpc.call(
+        cluster.master_addr, "GET", "/cluster/health")
+
+    # doctor names the breach and exits 1
+    from vearch_tpu.obs import doctor
+    report, code = doctor.run(cluster.master_addr)
+    assert code == 1
+    sq = next(ch for ch in report["checks"]
+              if ch["name"] == "search_quality")
+    assert not sq["ok"]
+    assert "db/s" in sq["detail"] and "retrain" in sq["detail"]
+
+    # -- retrain: the rebuild re-trains quantizers from the intact raw
+    # store; _run_build's staleness hook (lint VL105) resets the
+    # estimators, so the breach clears instead of decaying for minutes
+    rpc.call(ps.addr, "POST", "/ps/index/rebuild", {"partition_id": pid})
+    eng.wait_for_index(timeout=120)
+    assert ps._quality.breach_spaces() == []
+    fresh = ps._quality.collect_health()[pid]
+    assert not fresh["needs_retrain"], fresh
+
+    serve(15, start=200)
+    assert _poll(
+        lambda: (ps._quality.recall_snapshot()["spaces"]
+                 .get("db/s", {}).get("recall", {})
+                 .get("10", {}).get("samples", 0)) >= 10, 15.0)
+    snap = ps._quality.recall_snapshot()["spaces"]["db/s"]
+    assert snap["recall"]["10"]["estimate"] > FLOOR
+    assert snap["breach"] is False
+
+    def healthy():
+        h = rpc.call(cluster.master_addr, "GET", "/cluster/health")
+        return (h.get("recall_breach_spaces") == []
+                and h.get("needs_retrain_partitions") == [])
+    assert _poll(healthy, 10.0)
+    report2, _code2 = doctor.run(cluster.master_addr)
+    sq2 = next(ch for ch in report2["checks"]
+               if ch["name"] == "search_quality")
+    assert sq2["ok"], sq2["detail"]
+
+
+def test_master_validates_recall_floor_and_serves_it(cluster):
+    cl = VearchClient(cluster.router_addr)
+    cl.create_database("db")
+    with pytest.raises(Exception, match="recall_floor"):
+        cl.create_space("db", {
+            "name": "bad", "partition_num": 1,
+            "slo": {"recall_floor": 1.5},
+            "fields": [{"name": "v", "data_type": "vector",
+                        "dimension": D,
+                        "index": {"index_type": "FLAT",
+                                  "metric_type": "L2", "params": {}}}],
+        })
+    # a floor-only SLO is a valid declaration
+    cl.create_space("db", {
+        "name": "ok", "partition_num": 1,
+        "slo": {"recall_floor": 0.9},
+        "fields": [{"name": "v", "data_type": "vector", "dimension": D,
+                    "index": {"index_type": "FLAT", "metric_type": "L2",
+                              "params": {}}}],
+    })
+    ps = cluster.ps_nodes[0]
+    assert _poll(
+        lambda: ps._quality.stats()["floors"].get("db/ok") == 0.9, 10.0)
+
+
+# -- tiering read-ahead gate (ROADMAP carry-over) -----------------------------
+
+
+def test_readahead_gather_is_page_cache_only_zero_h2d(tmp_path):
+    """The madvise read-ahead (tiering/readahead.py) touches the page
+    cache, never the PCIe link: a warm strided gather over the NVMe
+    mmap moves exactly zero H2D bytes, and the advise path coalesces
+    the strided rows into bounded WILLNEED runs."""
+    from vearch_tpu.engine.disk_vector import DiskRawVectorStore
+    from vearch_tpu.tiering import readahead
+
+    store = DiskRawVectorStore(D, str(tmp_path / "rv"), row_cache_mb=0)
+    rng = np.random.default_rng(3)
+    rows = rng.standard_normal((4096, D)).astype(np.float32)
+    store.add(rows)
+    ids = np.arange(0, 4096, 17, dtype=np.int64)  # strided walk
+
+    # the advise path engages on the real memmap and bounds its runs
+    advised = readahead.advise_rows(store._host, ids)
+    assert 1 <= advised <= readahead._MAX_RUNS
+
+    h2d0 = perf_model.h2d_bytes_total()
+    got = store.get_rows(ids)
+    np.testing.assert_allclose(got, rows[ids], rtol=1e-6)
+    got2 = store.get_rows(ids)  # warm repeat
+    np.testing.assert_allclose(got2, rows[ids], rtol=1e-6)
+    assert perf_model.h2d_bytes_total() == h2d0, (
+        "a host-side mmap gather must not move device bytes")
+
+    # coalescing: clustered ids collapse to one run; a pathological
+    # spread stays bounded by _MAX_RUNS
+    runs = readahead._coalesce(np.arange(100, dtype=np.int64))
+    assert runs == [(0, 100)]
+    assert readahead._coalesce(np.zeros(0, dtype=np.int64)) == []
+    wide = np.arange(0, 4096, 40, dtype=np.int64)  # > _GAP_ROWS gaps
+    assert len(readahead._coalesce(wide)) == wide.size > readahead._MAX_RUNS
+    assert readahead.advise_rows(store._host, wide) == 1  # spanning run
+
+    # a plain in-memory array is a silent no-op, never an error
+    assert readahead.advise_rows(rows, ids) == 0

@@ -9,11 +9,14 @@ immediately with 429 + Retry-After instead of joining a queue that is
 already longer than anyone will wait for. Shed work never touches the
 engine, so it costs zero device dispatches.
 
-Two priority classes make the bounded queue a (two-level) priority
-queue: normal traffic sheds at ``queue_limit``, while high-priority
-requests (``priority >= 1`` in the search body — replica catch-up
-probes, operator diagnostics) are allowed to queue up to twice that
-depth, so a saturated node stays debuggable.
+Three priority classes make the bounded queue a priority queue: normal
+traffic sheds at ``queue_limit``; high-priority requests
+(``priority >= 1`` in the search body — replica catch-up probes,
+operator diagnostics) are allowed to queue up to twice that depth, so a
+saturated node stays debuggable; low-priority work (``priority < 0`` —
+the quality monitor's shadow ground-truth searches, obs/quality.py)
+sheds at HALF the depth, so background truth sampling is the first
+thing a loaded node drops and can never crowd out tenant traffic.
 
 ``queue_limit == 0`` disables shedding entirely (the default): the
 behavior is exactly the pre-admission-control gate.
@@ -49,6 +52,8 @@ class AdmissionController:
         limit = self.queue_limit
         if limit > 0 and int(priority) >= 1:
             limit *= 2
+        elif limit > 0 and int(priority) < 0:
+            limit = max(1, limit // 2)
         with self._lock:
             if limit > 0 and self._waiting >= limit:
                 self.shed_total += 1

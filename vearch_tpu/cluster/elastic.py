@@ -162,7 +162,9 @@ def compute_plan(
 
         {"imbalance": float, "node_loads": {node_id: bytes},
          "moves":  [{partition_id, from_node, to_node, reason}],
-         "splits": [{partition_id, db_name, space_name, reason}]}
+         "splits": [{partition_id, db_name, space_name, reason}],
+         "needs_retrain": [{partition_id, db_name, space_name,
+                            reasons}]}
 
     Moves are greedy hottest-node -> coldest-node: pick the heaviest
     partition on the most loaded node whose move (a) lands on a node
@@ -179,7 +181,30 @@ def compute_plan(
         "node_loads": {str(n): v for n, v in sorted(loads.items())},
         "moves": [],
         "splits": [],
+        "needs_retrain": [],
     }
+
+    # index-health retrain hints: the PS quality monitor's drift
+    # verdict (recon error off its train-time baseline, IVF cell
+    # imbalance, deleted/unindexed fractions) rides the heartbeat's
+    # per-partition "quality" block — surface it next to the placement
+    # plan so one endpoint answers "what should the autopilot do".
+    # Leader report wins; deterministic order by partition id.
+    for sp in sorted(spaces, key=lambda s: (s.db_name, s.name)):
+        for p in sorted(sp.partitions, key=lambda p: p.id):
+            q = None
+            for nid in [p.leader] + [r for r in p.replicas
+                                     if r != p.leader]:
+                st = node_stats.get(nid, {}).get(str(p.id)) or {}
+                if st.get("quality") is not None:
+                    q = st["quality"]
+                    break
+            if q and q.get("needs_retrain"):
+                plan["needs_retrain"].append({
+                    "partition_id": p.id, "db_name": sp.db_name,
+                    "space_name": sp.name,
+                    "reasons": list(q.get("reasons") or []),
+                })
 
     # partition weight/replicas index (leader report wins; any replica
     # report is better than nothing)

@@ -97,9 +97,12 @@ class Space:
     # compare against response-carried versions to hot-reload the map
     map_version: int = 0
     # declared service objective for this space, e.g.
-    # {"latency_ms": 50, "availability": 0.999} — the router scores
-    # every logical search against it and exports error-budget burn
-    # rates (docs/ACCOUNTING.md); None = unscored
+    # {"latency_ms": 50, "availability": 0.999, "recall_floor": 0.9} —
+    # the router scores every logical search against latency/
+    # availability and exports error-budget burn rates
+    # (docs/ACCOUNTING.md); recall_floor rides the master's register
+    # response to every hosting PS, whose shadow recall sampler flags a
+    # statistical breach (docs/QUALITY.md). None = unscored
     slo: dict | None = None
 
     def to_dict(self) -> dict[str, Any]:

@@ -1256,7 +1256,23 @@ class MasterServer:
             sp for sp, rec in slo.items() if rec.get("fast_burn"))
         if slo_burn_spaces and rank[status] < rank["yellow"]:
             status = "yellow"
+        # quality degradation: a space whose shadow-sampled recall sits
+        # statistically under its declared floor is serving wrong
+        # answers with green replication — that is a tenant-visible
+        # incident exactly like an SLO burn (docs/QUALITY.md)
+        recall_breach_spaces = sorted({
+            s for obs in list(self._node_obs.values())
+            for s in (obs.get("recall_breach_spaces") or [])
+        })
+        if recall_breach_spaces and rank[status] < rank["yellow"]:
+            status = "yellow"
+        needs_retrain = sorted({
+            int(p) for obs in list(self._node_obs.values())
+            for p in (obs.get("needs_retrain_pids") or [])
+        })
         return {"status": status, "spaces": spaces,
+                "recall_breach_spaces": recall_breach_spaces,
+                "needs_retrain_partitions": needs_retrain,
                 "slo_fast_burn_spaces": slo_burn_spaces,
                 "hbm_drift_nodes": drift_nodes,
                 "serving_compiles": sum(
@@ -1482,6 +1498,17 @@ class MasterServer:
         # that reloaded a stale local schema)
         expect, schemas = self._field_index_expectations()
         hosted = {str(pid) for pid in server.partition_ids}
+        # per-space recall floors (Space.slo.recall_floor) for the
+        # spaces this node hosts — the PS quality monitor applies them
+        # replace-not-merge, so removing a floor clears it node-side
+        floors: dict[str, float] = {}
+        for sp in self.store.prefix(PREFIX_SPACE).values():
+            rf = (sp.get("slo") or {}).get("recall_floor")
+            if rf is None:
+                continue
+            if any(str(p["id"]) in hosted
+                   for p in sp.get("partitions", [])):
+                floors[f"{sp['db_name']}/{sp['name']}"] = float(rf)
         return {"node_id": node_id,
                 "field_indexes": {
                     pid: flags for pid, flags in expect.items()
@@ -1490,7 +1517,8 @@ class MasterServer:
                 "schema_fields": {
                     pid: flds for pid, flds in schemas.items()
                     if pid in hosted
-                }}
+                },
+                "recall_floors": floors}
 
     def _h_servers(self, _body, _parts) -> dict:
         # merge the live heartbeat load into each record at read time:
@@ -2913,9 +2941,19 @@ class MasterServer:
             if thr <= 0:
                 raise RpcError(400, "slo.fast_burn_threshold must be > 0")
             out["fast_burn_threshold"] = thr
-        if "latency_ms" not in out and "availability" not in out:
+        if slo.get("recall_floor") is not None:
+            # shadow-sampled recall objective: PS nodes receive it via
+            # the register response and flag a statistical breach
+            # (docs/QUALITY.md); /cluster/health degrades to yellow
+            floor = float(slo["recall_floor"])
+            if not 0.0 < floor <= 1.0:
+                raise RpcError(400, "slo.recall_floor must be in (0, 1]")
+            out["recall_floor"] = floor
+        if not any(k in out for k in
+                   ("latency_ms", "availability", "recall_floor")):
             raise RpcError(
-                400, "slo must declare latency_ms and/or availability")
+                400, "slo must declare latency_ms, availability "
+                     "and/or recall_floor")
         return out
 
     def _validate_rule(self, rule: dict, schema: TableSchema) -> None:

@@ -325,6 +325,17 @@ class PSServer:
             drift_tolerance=hbm_drift_tolerance,
             drift_slack_bytes=int(hbm_drift_slack_mb) << 20,
         )
+        # search-quality truth layer (docs/QUALITY.md): shadow exact-
+        # rerank recall sampling + index-health drift gauges. Per-node,
+        # not process-global — in-process multi-node tests host the
+        # same partition id on several PSServers.
+        from vearch_tpu.obs.quality import QualityMonitor
+
+        self._quality = QualityMonitor(
+            get_engines=lambda: self.engines,
+            pid_space=self._space_key,
+            admission=self._admission,
+        )
 
         self.server = JsonRpcServer(host, port)
         self.server.tracer = self.tracer
@@ -552,6 +563,117 @@ class PSServer:
                          "pending double-write mirror entries for the "
                          "active partition split",
                          ("partition",), _split_queue)
+
+        # -- search-quality truth layer (docs/QUALITY.md) --------------
+        # Recall/RBO render under the accountant's top-K + "other" space
+        # label policy and the fixed RECALL_K_TIERS depth grid; health
+        # gauges are one series per hosted partition with 0.0 until the
+        # first health pass — the cardinality soak must see no series
+        # growth as sampling warms up mid-soak. Exact per-space numbers
+        # ride /ps/stats; these series exist for alerting.
+        from vearch_tpu.ops.perf_model import RECALL_K_TIERS
+
+        def _quality_space_labels() -> set[str]:
+            labels = {
+                self._accountant.label(self._space_key(pid))
+                for pid in list(self.engines)
+            }
+            labels.add(accounting.OTHER_LABEL)
+            return labels
+
+        def _recall_gauge():
+            snap = self._quality.recall_snapshot()["spaces"]
+            out = {(str(kt), lbl): 0.0
+                   for kt in RECALL_K_TIERS
+                   for lbl in _quality_space_labels()}
+            for space, sp in snap.items():
+                lbl = self._accountant.label(space)
+                for kt, rec in (sp.get("recall") or {}).items():
+                    if rec.get("estimate") is not None:
+                        out[(str(kt), lbl)] = float(rec["estimate"])
+            return out
+
+        def _rbo_gauge():
+            snap = self._quality.recall_snapshot()["spaces"]
+            out = {(lbl,): 0.0 for lbl in _quality_space_labels()}
+            for space, sp in snap.items():
+                if sp.get("rbo") is not None:
+                    out[(self._accountant.label(space),)] = float(sp["rbo"])
+            return out
+
+        def _breach_gauge():
+            hit = {self._accountant.label(s)
+                   for s in self._quality.breach_spaces()}
+            return {(lbl,): (1.0 if lbl in hit else 0.0)
+                    for lbl in _quality_space_labels()}
+
+        m.callback_gauge("vearch_ps_search_recall",
+                         "shadow-sampled recall@k vs the exact FLAT "
+                         "path, decayed estimate (0 until sampled)",
+                         ("k", "space"), _recall_gauge)
+        m.callback_gauge("vearch_ps_search_rbo",
+                         "rank-biased overlap of served vs exact "
+                         "ordering, decayed (0 until sampled)",
+                         ("space",), _rbo_gauge)
+        m.callback_gauge("vearch_ps_search_recall_floor_breach",
+                         "1 while the Wilson-upper recall bound sits "
+                         "under the space's recall floor",
+                         ("space",), _breach_gauge)
+        m.callback_counter("vearch_ps_quality_shadow_total",
+                           "shadow recall-sampling pipeline events "
+                           "(sampled/executed/shed/stale/dropped/error)",
+                           ("event",),
+                           lambda: {(e,): float(n) for e, n in
+                                    self._quality.counters().items()})
+
+        def _health_gauge(metric: str, field_level: bool):
+            def read():
+                h = self._quality.health_snapshot()
+                out = {}
+                for pid in list(self.engines):
+                    info = h.get(pid) or {}
+                    if not field_level:
+                        out[(str(pid),)] = float(info.get(metric) or 0.0)
+                        continue
+                    vals = [f[metric]
+                            for f in (info.get("fields") or {}).values()
+                            if f.get(metric) is not None]
+                    # worst field per partition: the gauge answers "does
+                    # this partition need attention", not "which field"
+                    out[(str(pid),)] = float(max(vals)) if vals else 0.0
+                return out
+            return read
+
+        m.callback_gauge("vearch_ps_index_health_recon_error",
+                         "quantization reconstruction error, worst "
+                         "vector field (relative L2)", ("partition",),
+                         _health_gauge("recon_error", True))
+        m.callback_gauge("vearch_ps_index_health_cell_imbalance",
+                         "IVF cell-population coefficient of variation, "
+                         "worst vector field", ("partition",),
+                         _health_gauge("cell_imbalance_cv", True))
+        m.callback_gauge("vearch_ps_index_health_deleted_frac",
+                         "deleted-doc fraction of the partition",
+                         ("partition",),
+                         _health_gauge("deleted_frac", False))
+        m.callback_gauge("vearch_ps_index_health_unindexed_frac",
+                         "tail appends not yet absorbed into the ANN "
+                         "index, worst vector field", ("partition",),
+                         _health_gauge("unindexed_frac", True))
+
+        def _retrain_gauge():
+            h = self._quality.health_snapshot()
+            return {
+                (str(pid),): (
+                    1.0 if (h.get(pid) or {}).get("needs_retrain")
+                    else 0.0)
+                for pid in list(self.engines)
+            }
+
+        m.callback_gauge("vearch_ps_index_health_needs_retrain",
+                         "1 when drift gauges say the partition should "
+                         "retrain (reasons in /ps/stats quality block)",
+                         ("partition",), _retrain_gauge)
 
         # raft replication observability (tentpole: VERDICT weak #2 was
         # undiagnosable because raft exposed no lag/latency/election
@@ -832,6 +954,7 @@ class PSServer:
         with self.flight_recorder.warmup():
             self._recover_partitions()
         self.device_sampler.start()
+        self._quality.start()
         if self.master_addr:
             threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name="ps-heartbeat").start()
@@ -845,6 +968,7 @@ class PSServer:
     def stop(self, flush: bool = True) -> None:
         self._stop.set()
         self.device_sampler.stop()
+        self._quality.stop()
         for pid in list(self.raft_nodes):
             if flush:
                 try:
@@ -938,6 +1062,11 @@ class PSServer:
                         if pid in self.raft_nodes
                         else int(eng.data_version)
                     ),
+                    # index-health drift block (recon error, cell
+                    # imbalance, deleted/unindexed fractions,
+                    # needs_retrain + reasons) — elastic.compute_plan
+                    # reads it out of the master's node stats
+                    "quality": self._quality.partition_stats(pid),
                 }
             except Exception:
                 continue
@@ -969,12 +1098,17 @@ class PSServer:
         }
 
     def _obs_summary(self) -> dict:
-        """Drift + compile digest riding the heartbeat."""
+        """Drift + compile + search-quality digest riding the
+        heartbeat (master: _node_obs -> /cluster/health)."""
         samp = self.device_sampler.snapshot()
         return {
             "hbm_drift": bool(samp.get("drift")),
             "drift_bytes": int(samp.get("drift_bytes") or 0),
             "compiles_post_warmup": self.flight_recorder.total(),
+            # spaces whose shadow-sampled recall sits statistically
+            # under their floor, and partitions whose drift gauges say
+            # retrain — the master degrades /cluster/health on these
+            **self._quality.obs_summary(),
         }
 
     def _load_summary(self) -> dict:
@@ -1037,6 +1171,15 @@ class PSServer:
                 )
             except Exception:
                 _log.exception("field-index reconcile failed")
+            try:
+                # per-space recall floors from Space.slo ride the
+                # register response; replace-not-merge, so dropping a
+                # floor from the space config clears it here too
+                if "recall_floors" in resp:
+                    self._quality.set_floors(
+                        resp.get("recall_floors") or {})
+            except Exception:
+                _log.exception("recall-floor apply failed")
 
     def _reconcile_schema_fields(
         self, expect: dict[str, list]
@@ -1459,6 +1602,14 @@ class PSServer:
             self._build_hist.observe(
                 float(job.get("duration_seconds") or 0.0),
                 str(_pid), str(job.get("op", "build")))
+            if job.get("status") == "done":
+                # a finished (re)build replaced the serving index: reset
+                # the recall estimators and the train-time recon
+                # baseline (staleness hook, lint VL105) — this covers
+                # background auto-builds no request handler ever sees
+                self._quality.note_index_mutation(
+                    _pid, self._space_key(_pid),
+                    op=str(job.get("op", "build")))
         eng.build_observer = on_build_done
 
     def _h_create_partition(self, body: dict, _parts) -> dict:
@@ -1486,6 +1637,7 @@ class PSServer:
 
     def _h_delete_partition(self, body: dict, _parts) -> dict:
         pid = int(body["partition_id"])
+        space = self._space_key(pid)  # before the registry pop below
         # an active split ends here: for a committed split this IS the
         # normal finalization (the master deletes the parent last); the
         # teardown drains the mirror queue while the engine still lives
@@ -1502,6 +1654,9 @@ class PSServer:
         shutil.rmtree(
             os.path.join(self.data_dir, f"partition_{pid}"), ignore_errors=True
         )
+        # drop quality state keyed by the gone partition (warm keys,
+        # health, recall cells for its space — VL105 staleness hook)
+        self._quality.note_index_mutation(pid, space, op="")
         return {"partition_id": pid}
 
     # -- writes: every mutation is a log proposal ---------------------------
@@ -2130,6 +2285,31 @@ class PSServer:
             ctx=ctx,
         )
         results = eng.search(req)
+        # shadow recall sampling (docs/QUALITY.md): offer every served
+        # row to the deterministic sampler BEFORE wire shaping, so what
+        # gets scored is exactly what the client saw. Exact searches are
+        # their own ground truth; sort reorders by non-score keys, so
+        # recall-vs-score-truth would be meaningless for them. Hooked
+        # here (not in _h_search) so cache hits/coalesced followers —
+        # which re-serve an already-offered result — never double-count.
+        if not req.brute_force and not body.get("sort"):
+            try:
+                from vearch_tpu.engine.types import ColumnarSearchResults
+
+                pid_q = int(body["partition_id"])
+                self._quality.observe_search(
+                    pid_q, self._space_key(pid_q), vectors,
+                    int(body.get("k", 10)),
+                    (results.keys
+                     if isinstance(results, ColumnarSearchResults)
+                     else results),
+                    int(eng.data_version),
+                    index_params=body.get("index_params") or {},
+                    filters=body.get("filters"),
+                    field_weights=body.get("field_weights") or {},
+                )
+            except Exception as e:  # sampling must never fail a search
+                internal_error("ps.quality_sample", e)
         metric = eng.indexes[next(iter(vectors))].metric.value
         if columnar:
             from vearch_tpu.engine.types import ColumnarSearchResults
@@ -2220,6 +2400,11 @@ class PSServer:
                     eng.rebuild_index()
                 else:
                     eng.build_index()
+            # estimator staleness (lint VL105): the serving snapshot
+            # just changed under any queued shadow samples
+            self._quality.note_index_mutation(
+                pid, self._space_key(pid),
+                op="rebuild" if rebuild else "build")
         finally:
             job = eng.build_job
             if job is not None:
@@ -2424,6 +2609,12 @@ class PSServer:
                              f"cutover-ready (phase {job['phase']})")
                 job["_finish"] = "commit" if commit else "abort"
                 self._split_cv.notify_all()
+        if commit:
+            # cutover moves the space's rows to the children: the
+            # parent's accumulated recall stream no longer describes
+            # what the space serves (staleness hook, lint VL105)
+            self._quality.note_index_mutation(
+                pid, self._space_key(pid), op="split")
         # wait for the worker to acknowledge: commit -> phase
         # "committed" (mirror stays open until the parent is deleted);
         # abort -> terminal status
@@ -2784,6 +2975,15 @@ class PSServer:
             # fault injection (tail-latency tests/bench): per-search
             # killable sleep before any engine work
             self.debug_search_delay_ms = int(cfg["debug_search_delay_ms"])
+        if "quality" in cfg:
+            # shadow-sampling knobs (docs/QUALITY.md): sample_rate,
+            # decay, min_samples, health cadence + drift thresholds
+            q = dict(cfg["quality"] or {})
+            if "sample_rate" in q and not (
+                    0.0 <= float(q["sample_rate"]) <= 1.0):
+                raise RpcError(400,
+                               "quality.sample_rate must be in [0, 1]")
+            self._quality.configure(**q)
         if "log_level" in cfg:
             # runtime log-level flip, fanned out by the master's /config
             # (reference: log-level runtime config in pkg/log)
@@ -2955,6 +3155,11 @@ class PSServer:
                     self.engines[pid] = restored
                 with self._stats_lock:
                     self._mem_dirty = True
+                # the restore rewrote the corpus AND the quantizers:
+                # reset recall estimators + the train-time recon
+                # baseline (staleness hook, lint VL105)
+                self._quality.note_index_mutation(
+                    pid, self._space_key(pid), op="restore")
                 # restored state supersedes the log: reset it at the
                 # current applied horizon (a point-in-time rewind).
                 # last_term is the term AT last_index, so the horizon
@@ -3001,6 +3206,10 @@ class PSServer:
             # admission-control counters (sheds, waiters, limit) — the
             # doctor's shed-rate check reads these
             "admission": self._admission.snapshot(),
+            # search-quality truth layer: shadow-sampling counters,
+            # per-space recall/RBO estimators + floors, index-health
+            # drift — the doctor's search_quality check reads this
+            "quality": self._quality.stats(),
             # per-tenant cost meters (exact keys, never label-collapsed)
             # + this node's per-space HBM residency split — the same
             # block the heartbeat carries (docs/ACCOUNTING.md)

@@ -36,6 +36,7 @@ import numpy as np
 
 from vearch_tpu.engine.raw_vector import RawVectorStore
 from vearch_tpu.tiering import HostRowCache
+from vearch_tpu.tiering import readahead
 
 
 class DiskRawVectorStore(RawVectorStore):
@@ -131,7 +132,12 @@ class DiskRawVectorStore(RawVectorStore):
         stale — the load paths clear the cache before rewriting)."""
 
         def _gather(ids: np.ndarray) -> np.ndarray:
-            return np.asarray(self._host[np.asarray(ids, dtype=np.int64)])
+            ids = np.asarray(ids, dtype=np.int64)
+            # async kernel read-ahead for the strided page faults the
+            # gather is about to take (tiering/readahead.py) — page
+            # cache only, zero H2D
+            readahead.advise_rows(self._host, ids)
+            return np.asarray(self._host[ids])
 
         if self.row_cache is None:
             return _gather(docids).astype(np.float32, copy=False)

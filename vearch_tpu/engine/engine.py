@@ -27,6 +27,7 @@ from typing import Any
 
 import numpy as np
 
+from vearch_tpu.cluster import metrics as cluster_metrics
 from vearch_tpu.engine.bitmap import BitmapManager
 from vearch_tpu.obs import accounting as _acct
 from vearch_tpu.engine.raw_vector import RawVectorStore
@@ -390,6 +391,53 @@ class Engine:
             if codes is not None:
                 total += codes.nbytes
         return total
+
+    def quality_info(self) -> dict[str, Any]:
+        """Index-health raw numbers for the quality monitor's drift
+        gauges (obs/quality.py collect_health): deleted/unindexed
+        fractions plus per-field quantization reconstruction error and
+        cell-population imbalance. Host work only — no device dispatch
+        (the monitor samples this on a background cadence)."""
+        total = int(self.table.doc_count)
+        deleted = int(self.bitmap.deleted_count)
+        info: dict[str, Any] = {
+            "doc_count": total - deleted,
+            "deleted_count": deleted,
+            "deleted_frac": deleted / total if total else 0.0,
+            "data_version": int(self.data_version),
+            "fields": {},
+        }
+        for name, index in self.indexes.items():
+            n = int(index.store.count)
+            if index.needs_training and n:
+                unindexed = (n - min(int(index.indexed_count), n)) / n
+            else:
+                # FLAT-family indexes scan the raw store directly: the
+                # tail is always searched, never "unindexed"
+                unindexed = 0.0
+            f: dict[str, Any] = {
+                "index_type": index.params.index_type,
+                "trained": bool(index.trained),
+                "indexed_count": int(index.indexed_count),
+                "unindexed_frac": unindexed,
+            }
+            try:
+                f["recon_error"] = index.reconstruction_error()
+            except Exception as e:
+                cluster_metrics.internal_error("engine.quality_info", e)
+                f["recon_error"] = None
+            pops = index.cell_populations()
+            if pops:
+                arr = np.asarray(pops, dtype=np.float64)
+                mean = float(arr.mean())
+                f["ncells"] = len(pops)
+                f["cell_min"] = int(arr.min())
+                f["cell_max"] = int(arr.max())
+                f["cell_imbalance_cv"] = (
+                    float(arr.std() / mean) if mean > 0 else 0.0
+                )
+            info["fields"][name] = f
+        return info
 
     def query(
         self,

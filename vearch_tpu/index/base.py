@@ -118,6 +118,24 @@ class VectorIndex(abc.ABC):
         serves entirely from device memory."""
         return None
 
+    # -- index-health drift gauges (obs/quality.py collect_health) -------
+
+    def cell_populations(self) -> list[int] | None:
+        """Per-cell member counts for population-imbalance gauges, None
+        for index types without a coarse partitioning (FLAT)."""
+        return None
+
+    def reconstruction_error(self, sample: int = 256,
+                             seed: int = 0) -> float | None:
+        """Mean relative reconstruction error ‖x − dequant(quant(x))‖ /
+        ‖x‖ over `sample` STORED rows (the codes actually scored at
+        serve time, not a fresh re-encode — so stale codebooks and
+        corrupt scales both move the gauge). None when the index stores
+        rows exactly or is untrained. Host-side only: implementations
+        must not dispatch device programs (this runs on the quality
+        monitor's background cadence)."""
+        return None
+
     def close(self) -> None:
         """Release background resources (prefetch workers, mmaps).
         Idempotent; default is a no-op for in-memory indexes."""

@@ -60,6 +60,7 @@ from vearch_tpu.tiering import (
     HostRamSlabTier,
     PrefetchWorker,
     SequencePredictor,
+    readahead,
 )
 from vearch_tpu.tools import lockcheck
 
@@ -198,6 +199,34 @@ class DiskANNIndex(VectorIndex):
                 self._extend_members(assign, lo)
             self.indexed_count = upto
 
+    def cell_populations(self) -> list[int] | None:
+        with self._absorb_lock:
+            if not self.trained:
+                return None
+            return [len(mm) for mm in self._members]
+
+    def reconstruction_error(self, sample: int = 256,
+                             seed: int = 0) -> float | None:
+        """Dequantize STORED int8 scan rows (a8 * scale) against the raw
+        store — reads the mmaps directly, no device work."""
+        with self._absorb_lock:
+            n = int(self.indexed_count)
+            if not self.trained or n == 0 or self._a8 is None:
+                return None
+            rng = np.random.default_rng(seed)
+            ids = np.sort(rng.choice(n, size=min(int(sample), n),
+                                     replace=False))
+            raw = self._maybe_normalize(
+                np.asarray(self.store.host_view()[ids], dtype=np.float32)
+            )
+            approx = (
+                np.asarray(self._a8[ids], dtype=np.float32)
+                * np.asarray(self._m2[ids, 0], dtype=np.float32)[:, None]
+            )
+            num = np.linalg.norm(raw - approx, axis=1)
+            den = np.maximum(np.linalg.norm(raw, axis=1), 1e-12)
+            return float(np.mean(num / den))
+
     def _extend_members(self, assign: np.ndarray, start: int) -> None:
         order = np.argsort(assign, kind="stable")
         sorted_assign = assign[order]
@@ -260,6 +289,12 @@ class DiskANNIndex(VectorIndex):
                 ids = ids[ids < n_snap]
                 a8, m2 = self._a8, self._m2
                 ids = ids[ids < a8.shape[0]]
+                # kernel read-ahead before the strided mmap gathers: a
+                # cold slab faults its rows as a few batched NVMe reads
+                # instead of one synchronous fault per page
+                # (tiering/readahead.py — page cache only, zero H2D)
+                readahead.advise_rows(a8, ids)
+                readahead.advise_rows(m2, ids)
                 return (
                     np.asarray(a8[ids]),
                     np.asarray(m2[ids, 0]),

@@ -279,6 +279,13 @@ class _IVFBase(VectorIndex):
             np.pad(ids, ((0, 0), (0, pad)), constant_values=-1),
         )
 
+    def cell_populations(self) -> list[int] | None:
+        """Live per-cell member counts (quality drift gauge input)."""
+        with self._absorb_lock:
+            if not self.trained:
+                return None
+            return [len(mm) for mm in self._members]
+
     def dump_state(self) -> dict[str, Any]:
         if not self.trained:
             return {}
@@ -377,6 +384,12 @@ class IVFFlatIndex(_IVFBase):
         # similarity needs the query-norm correction only for reporting,
         # which normalization already handled.
         return self._pad_to_k(scores, ids, k)
+
+    def reconstruction_error(self, sample: int = 256,
+                             seed: int = 0) -> float | None:
+        # buckets hold the raw vectors (store_dtype): scoring is exact,
+        # so the quantization-drift gauge is identically zero
+        return 0.0 if self.trained else None
 
 
 @register_index("IVFPQ")
@@ -620,6 +633,35 @@ class IVFPQIndex(_IVFBase):
         self._bucket_scale = jnp.asarray(scales)
         self._bucket_vsq = jnp.asarray(vsq)
         self._dirty = False
+
+    def reconstruction_error(self, sample: int = 256,
+                             seed: int = 0) -> float | None:
+        """Decode the STORED codes (the serving representation) back to
+        full vectors and compare against the raw store — host numpy
+        only, no device dispatch. Covers SCANN too (same stored-code
+        layout; the anisotropic encoder only changes which codes were
+        chosen, not how they decode)."""
+        with self._absorb_lock:
+            n = int(self.indexed_count)
+            if not self.trained or n == 0 or self._codes is None:
+                return None
+            rng = np.random.default_rng(seed)
+            ids = np.sort(rng.choice(n, size=min(int(sample), n),
+                                     replace=False))
+            raw = self._maybe_normalize(
+                np.asarray(self.store.host_view()[ids], dtype=np.float32)
+            )
+            decoded = pq_ops.decode_pq_np(self._codes[ids], self.codebooks)
+            if self._opq_R is not None:
+                decoded = decoded @ self._opq_R.T
+            cents = np.asarray(self.centroids)
+            approx = cents[self._assign_host[ids]] + decoded
+            if self.metric is MetricType.COSINE:
+                approx = approx / np.maximum(
+                    np.linalg.norm(approx, axis=1, keepdims=True), 1e-12)
+            num = np.linalg.norm(raw - approx, axis=1)
+            den = np.maximum(np.linalg.norm(raw, axis=1), 1e-12)
+            return float(np.mean(num / den))
 
     def search(
         self,

@@ -11,6 +11,9 @@ This package closes that gap:
                        HBM bytes, H2D counters, compiled programs,
                        footprint-model drift)
 - ``doctor``           cluster-wide collector + invariant checks
+- ``quality``          search-quality truth: shadow exact-rerank recall
+                       sampling + index-health drift gauges
+- ``accounting``       per-space cost meters + SLO burn engine
 
 Nothing here dispatches device programs (lint VL101: obs/ is not a
 dispatch package); the sampler only *reads* runtime introspection

@@ -55,6 +55,11 @@ from vearch_tpu.tools import lockcheck
 #: prefetch threads, background builds) — keeps sums conservation-exact
 SYSTEM_SPACE = "_system"
 
+#: reserved bucket for shadow ground-truth traffic (obs/quality.py):
+#: recall-estimation re-executions bill their exact FLAT cost here so
+#: tenant meters never inflate while conservation stays sum-exact
+QUALITY_SPACE = "__quality__"
+
 #: collapsed metric label once the per-space label budget is spent
 OTHER_LABEL = "other"
 

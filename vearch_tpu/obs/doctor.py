@@ -16,6 +16,10 @@ invariant checks:
                            its error budget (router burn-rate windows)
 - ``usage_conservation``   every PS's per-tenant meters sum exactly to
                            its accountant totals (docs/ACCOUNTING.md)
+- ``search_quality``       no space's shadow-sampled recall sits
+                           statistically under its declared floor, and
+                           no partition's index-health drift gauges say
+                           retrain (docs/QUALITY.md)
 - ``obs_docs``             docs/OBSERVABILITY.md matches the source
                            (skipped when no source tree is present)
 
@@ -323,6 +327,41 @@ def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
         "name": "usage_conservation", "ok": not leaks,
         "detail": ("; ".join(leaks) if leaks
                    else "per-space meters reconcile to totals exactly"),
+    })
+
+    # search-quality truth: a recall-floor breach means the cluster is
+    # serving statistically-wrong answers with green replication; a
+    # needs_retrain verdict means the index structure has drifted off
+    # its train-time baseline. Both are actionable by name.
+    bad_quality = []
+    sampled_spaces = 0
+    for srv in report.get("servers", []):
+        q = (srv.get("stats") or {}).get("quality") or {}
+        for space, rec in (q.get("recall") or {}).items():
+            sampled_spaces += 1
+            if rec.get("breach"):
+                worst = min(
+                    (t.get("estimate") for t in
+                     (rec.get("recall") or {}).values()
+                     if t.get("estimate") is not None),
+                    default=None,
+                )
+                bad_quality.append(
+                    f"node {srv.get('node_id')} space {space}: recall "
+                    f"{worst} under floor {rec.get('floor')}"
+                )
+        for pid, h in (q.get("health") or {}).items():
+            if h.get("needs_retrain"):
+                bad_quality.append(
+                    f"node {srv.get('node_id')} partition {pid} needs "
+                    f"retrain: {'; '.join(h.get('reasons') or [])}"
+                )
+    checks.append({
+        "name": "search_quality", "ok": not bad_quality,
+        "detail": ("; ".join(bad_quality) if bad_quality
+                   else (f"{sampled_spaces} sampled space(s) above "
+                         f"floor, no retrain hints" if sampled_spaces
+                         else "no shadow samples to judge")),
     })
 
     try:

@@ -172,6 +172,11 @@ def path_for_dispatches(tags: list[str]) -> str | None:
 ROW_BUCKETS: tuple[int, ...] = (8, 64, 256, 1024)
 #: declared fetch-k tiers (candidate depth handed to the index)
 FETCH_K_TIERS: tuple[int, ...] = (16, 64, 256, 1024)
+#: declared recall-estimator depths (obs/quality.py shadow sampling):
+#: head correctness, the common serving page, and candidate-set health.
+#: Declared here with the other tier grids so VL103 keeps quality code
+#: off ad-hoc depth literals.
+RECALL_K_TIERS: tuple[int, ...] = (1, 10, 100)
 
 
 def bucket_rows(b: int) -> int:

@@ -30,6 +30,7 @@ See docs/TIERING.md for the tier map, knobs and runbook.
 
 from vearch_tpu.tiering.prefetch import PrefetchWorker, SequencePredictor
 from vearch_tpu.tiering.ram_tier import HostRamSlabTier, HostRowCache
+from vearch_tpu.tiering.readahead import advise_rows
 from vearch_tpu.tiering.staging import scatter_slabs
 
 __all__ = [
@@ -37,5 +38,6 @@ __all__ = [
     "HostRowCache",
     "PrefetchWorker",
     "SequencePredictor",
+    "advise_rows",
     "scatter_slabs",
 ]

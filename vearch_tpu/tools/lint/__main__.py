@@ -35,7 +35,7 @@ def main(argv: list[str] | None = None) -> int:
     # --list-rules force it eagerly
     from vearch_tpu.tools.lint import (  # noqa: F401
         rules_accounting, rules_buckets, rules_dispatch, rules_errors,
-        rules_locks, rules_obs,
+        rules_locks, rules_obs, rules_quality,
     )
 
     if args.list_rules:

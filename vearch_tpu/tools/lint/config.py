@@ -95,6 +95,21 @@ VL104_SERVING_FILES = (
 # per-tenant failures (kills, sheds) and must pass a space label
 VL104_BILLABLE_COUNTERS = ("_killed_total", "_shed_total")
 
+# -- VL105 quality staleness --------------------------------------------------
+# The search-quality truth layer (docs/QUALITY.md) scores served
+# results against fresh exact ground truth. Any function that replaces
+# the serving index (an engine build/rebuild call) must also call the
+# monitor's staleness hook, or queued shadow samples get scored against
+# a snapshot that no longer serves — phantom recall loss. Matched by
+# path suffix in the files that own index mutation.
+VL105_QUALITY_FILES = (
+    "vearch_tpu/cluster/ps.py",
+)
+# attribute-call names that replace index contents wholesale
+VL105_INDEX_MUTATORS = ("build_index", "rebuild_index")
+# the QualityMonitor staleness hook every such function must also call
+VL105_STALENESS_HOOK = "note_index_mutation"
+
 # -- VL201 lock discipline ----------------------------------------------------
 # Methods treated as mutations when called on a guarded attribute.
 MUTATOR_METHODS = {
