@@ -754,6 +754,96 @@ def search_quality_bench(dry: bool) -> dict:
     return out
 
 
+def progressive_refinement_bench(dry: bool) -> dict:
+    """Progressive three-stage refinement (binary -> int8 -> exact) vs
+    the int8-only chain (full int8 scan -> exact rerank) at MATCHED
+    recall: both chains finish with an exact rerank of their top-r1
+    estimate, so with the same r1 the only difference is how the r1
+    candidate set is produced — a 1-bit packed stage-0 scan feeding an
+    int8 rescore of top-r0, or the full-width int8 scan. Reports
+    QPS + recall@10/@100 per chain and the HBM bytes/vector of each
+    tier straight from the device ledger (mirror/bit-plane
+    device_bytes over capacity, cross-checked against the perf model).
+    """
+    from vearch_tpu.engine.engine import Engine, SearchRequest
+    from vearch_tpu.engine.types import (
+        DataType, FieldSchema, IndexParams, MetricType, TableSchema,
+    )
+    from vearch_tpu.ops import perf_model as pm
+
+    d = 64
+    n, nq, nc = (4_000, 16, 32) if dry else (200_000, 64, 512)
+    rng = np.random.default_rng(17)
+    base = rng.standard_normal((n, d)).astype(np.float32)
+    queries = (base[rng.choice(n, nq, replace=False)]
+               + 0.05 * rng.standard_normal((nq, d)).astype(np.float32))
+    d2 = ((base.astype(np.float64) ** 2).sum(1)[None, :]
+          - 2.0 * queries.astype(np.float64) @ base.astype(np.float64).T)
+    gt = np.argsort(d2, axis=1, kind="stable")[:, :100]
+
+    schema = TableSchema("pr", [
+        FieldSchema("v", DataType.VECTOR, dimension=d,
+                    index=IndexParams("IVFRABITQ", MetricType.L2,
+                                      {"ncentroids": nc,
+                                       "training_threshold": n})),
+    ])
+    eng = Engine(schema)
+    for i in range(0, n, 20_000):
+        eng.upsert([{"_id": str(j), "v": base[j]}
+                    for j in range(i, min(i + 20_000, n))])
+    eng.build_index()
+    idx = eng.indexes["v"]
+
+    r1 = min(max(10 * 10, 128), n)          # shared exact-rerank depth
+    r0 = min(max(8 * r1, 512), n)           # stage-0 survivor budget
+    chains = {
+        "three_stage": {"r0": r0, "r1": r1},
+        "int8_exact": {"stage0": "off", "rerank": r1},
+    }
+
+    def run(sp, k):
+        res = eng.search(SearchRequest(vectors={"v": queries}, k=k,
+                                       include_fields=[],
+                                       index_params=sp))
+        return [[int(it.key) for it in r.items] for r in res]
+
+    reps = 3 if dry else 20
+    out = {"n": n, "d": d, "r0": r0, "r1": r1, "chains": {}}
+    for name, sp in chains.items():
+        got10, got100 = run(sp, 10), run(sp, 100)
+        t0 = time.time()
+        for _ in range(reps):
+            run(sp, 10)
+        qps = reps * nq / (time.time() - t0)
+        out["chains"][name] = {
+            "qps": round(qps, 1),
+            "recall_at_10": round(float(np.mean([
+                len(set(g) & set(gt[q, :10].tolist())) / 10
+                for q, g in enumerate(got10)])), 4),
+            "recall_at_100": round(float(np.mean([
+                len(set(g) & set(gt[q, :100].tolist())) / 100
+                for q, g in enumerate(got100)])), 4),
+        }
+    # HBM bytes per vector, device ledger vs perf model: the stage-0
+    # tier must cost <= 1/8 of the int8 mirror's row payload
+    cap = idx._bits._h8.shape[0]
+    bits_b, mirror_b = idx._bits.device_bytes(), idx._mirror.device_bytes()
+    assert bits_b == pm.binary_footprint_bytes(cap, d)
+    assert mirror_b == pm.mirror_footprint_bytes(cap, d)
+    out["hbm"] = {
+        "rows_capacity": cap,
+        "bits_bytes_per_vector": round(bits_b / cap, 2),
+        "int8_bytes_per_vector": round(mirror_b / cap, 2),
+        "plane_payload_ratio": round(
+            pm.binary_plane_bytes(cap, d) / (cap * d), 4),
+    }
+    r10 = {c: out["chains"][c]["recall_at_10"] for c in chains}
+    out["recall_gap_at_10"] = round(
+        r10["int8_exact"] - r10["three_stage"], 4)
+    eng.close()
+    return out
+
+
 def main():
     if _dryrun():
         import jax as _jax
@@ -996,6 +1086,19 @@ def main():
         emit("quality", **quality_diag)
     else:
         emit("quality_resumed", **quality_diag)
+
+    # -- progressive refinement (stage-0 tentpole): binary->int8->exact
+    # vs int8->exact at matched rerank depth, plus HBM bytes/vector per
+    # tier. Resumable like the tail phase; never kills the headline.
+    pr_diag = _phase_cached(partial_path, "progressive_refinement")
+    if pr_diag is None:
+        try:
+            pr_diag = progressive_refinement_bench(_dryrun())
+        except Exception as e:
+            pr_diag = {"error": f"{type(e).__name__}: {e}"}
+        emit("progressive_refinement", **pr_diag)
+    else:
+        emit("progressive_refinement_resumed", **pr_diag)
 
     # -- per-phase breakdown (r4 review next-1: the captured headline
     # must be decomposable — where does the wall time go?) ------------

@@ -61,6 +61,26 @@ def test_compat_key_mixes_k_within_tier():
     assert _compat_key(a, tiered=False) != _compat_key(b, tiered=False)
 
 
+def test_compat_key_splits_on_refine_depths():
+    """Three-stage refinement depths are static program args: requests
+    tuned to different r0/r1 (or stage0 mode) must not share a bucket,
+    while identical tunings still co-batch."""
+    base = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                         index_params={"r0": 2048, "r1": 256})
+    same = SearchRequest(vectors={"v": np.zeros((1, D))}, k=9,
+                         index_params={"r0": 2048, "r1": 256})
+    deeper = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                           index_params={"r0": 4096, "r1": 256})
+    shallower = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                              index_params={"r0": 2048, "r1": 128})
+    off = SearchRequest(vectors={"v": np.zeros((1, D))}, k=5,
+                        index_params={"stage0": "off"})
+    assert _compat_key(base) == _compat_key(same)
+    assert _compat_key(base) != _compat_key(deeper)
+    assert _compat_key(base) != _compat_key(shallower)
+    assert _compat_key(base) != _compat_key(off)
+
+
 def test_compat_key_sort_and_bounds_need_exact_k():
     """Result shaping (sort, score window) applies at the group's k, so
     trimming a deeper group afterwards would diverge from the solo run:

@@ -150,6 +150,121 @@ def test_online_split_under_concurrent_traffic(tmp_path, rng):
         c.stop()
 
 
+def test_online_split_replicated_partition_under_traffic(tmp_path, rng):
+    """Split a replica_num=2 partition while writers and searchers
+    hammer it: zero lost docs, zero duplicated docs, both children
+    keep the replica factor, and EVERY replica of every child serves
+    the full doc set (direct per-PS query, not just via the router)."""
+    c = StandaloneCluster(data_dir=str(tmp_path / "c"), n_ps=2)
+    c.start()
+    try:
+        cl = VearchClient(c.router_addr, master_addr=c.master_addr)
+        _mk_space(cl, replica_num=2)
+        vecs = rng.standard_normal((1200, D)).astype(np.float32)
+        seed_ids = [f"seed{i}" for i in range(300)]
+        cl.upsert("db", "s", [{"_id": k, "v": vecs[i].tolist()}
+                              for i, k in enumerate(seed_ids)])
+        space0 = cl.get_space("db", "s")
+        parent = space0["partitions"][0]["id"]
+        assert len(space0["partitions"][0]["replicas"]) == 2
+
+        acked: list[str] = []
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def writer(tid: int):
+            i = 0
+            try:
+                while not stop.is_set():
+                    ids = [f"w{tid}_{i + j}" for j in range(10)]
+                    cl.upsert("db", "s", [
+                        {"_id": k, "v": vecs[(300 + i + j) % 1200].tolist()}
+                        for j, k in enumerate(ids)
+                    ])
+                    acked.extend(ids)
+                    i += 10
+            except Exception as e:
+                errors.append(e)
+
+        def searcher():
+            try:
+                while not stop.is_set():
+                    out = cl.search(
+                        "db", "s", [{"field": "v", "feature": vecs[0]}],
+                        limit=3)
+                    assert len(out) == 1 and out[0]
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+                   for t in range(2)]
+        threads += [threading.Thread(target=searcher, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            job = cl.split_partition("db", "s", parent, timeout_s=120.0)
+            done = cl.wait_elastic_job(job["job_id"], timeout_s=120.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert not errors, [repr(e) for e in errors[:3]]
+        assert done["status"] == "done" and done["op"] == "split"
+
+        # both children exist and kept the replica factor
+        space1 = cl.get_space("db", "s")
+        children = space1["partitions"]
+        assert len(children) == 2
+        assert parent not in [p["id"] for p in children]
+        for p in children:
+            assert len(set(p["replicas"])) == 2, p
+
+        # zero lost, zero duplicated through the router
+        expected = sorted(set(seed_ids) | set(acked))
+        assert len(expected) == len(seed_ids) + len(acked)
+        got = _all_ids(cl, len(expected))
+        assert sorted(got) == expected, (
+            f"{len(expected)} acked vs {len(got)} served"
+        )
+
+        # every replica of every child serves its shard: query each
+        # hosting PS directly (follower replication is async — poll the
+        # whole picture until replicas agree and the union is complete)
+        import time as _time
+        addr_of = {ps.node_id: ps.addr for ps in c.ps_nodes}
+
+        def _replica_sets():
+            out = []
+            for p in children:
+                out.append([{
+                    d["_id"] for d in rpc.call(
+                        addr_of[node], "POST", "/ps/doc/query",
+                        {"partition_id": p["id"],
+                         "limit": len(expected) + 50, "fields": []},
+                    )["documents"]
+                } for node in p["replicas"]])
+            return out
+
+        deadline = _time.monotonic() + 60
+        while True:
+            per_child = _replica_sets()
+            converged = all(
+                all(s == sets[0] for s in sets[1:]) for sets in per_child
+            ) and sorted(set().union(*(s[0] for s in per_child))) == expected
+            if converged or _time.monotonic() > deadline:
+                break
+            _time.sleep(0.2)
+        for p, sets in zip(children, per_child):
+            assert all(s == sets[0] for s in sets[1:]), (
+                p["id"], [len(s) for s in sets])
+        # the two children partition the doc set: disjoint, complete
+        a, b = per_child[0][0], per_child[1][0]
+        assert not (a & b)
+        assert sorted(a | b) == expected
+    finally:
+        c.stop()
+
+
 def test_migrate_to_fresh_ps_then_drain_source(tmp_path, rng):
     """Join a brand-new PS, stream a replica onto it, then drain the
     original PS empty — with a searcher asserting zero failed queries

@@ -112,6 +112,40 @@ def test_ivfrabitq_recall_with_rerank(rng):
     assert hits / (30 * 10) >= 0.8  # 1-bit quant + exact rerank
 
 
+def test_ivfrabitq_three_stage_recall_matches_int8_only(rng):
+    """Acceptance gate: end-to-end recall@10 after the exact rerank is
+    within 0.01 of the int8-only chain (stage0=off) at the same r1 —
+    the 1-bit stage-0 filter buys its 8x density without giving up
+    result quality."""
+    centers = rng.standard_normal((40, 32)).astype(np.float32) * 4
+    which = rng.integers(0, 40, 4000)
+    vecs = centers[which] + 0.5 * rng.standard_normal(
+        (4000, 32)).astype(np.float32)
+    eng = _mk_engine("IVFRABITQ",
+                     params={"ncentroids": 32, "training_threshold": 500})
+    eng.upsert([{"_id": f"d{i}", "emb": vecs[i]} for i in range(4000)])
+    eng.wait_for_index()
+    eng.build_index()
+    queries = vecs[rng.choice(4000, 30, replace=False)]
+    ref = np.argsort(
+        ((queries[:, None] - vecs[None]) ** 2).sum(-1), axis=1)[:, :10]
+
+    def recall(sp):
+        res = eng.search(SearchRequest(vectors={"emb": queries}, k=10,
+                                       index_params=sp))
+        hits = sum(
+            len({int(it.key[1:]) for it in r.items}
+                & set(ref[qi].tolist()))
+            for qi, r in enumerate(res)
+        )
+        return hits / (30 * 10)
+
+    r1 = 256
+    three = recall({"r0": 1024, "r1": r1})
+    int8_only = recall({"stage0": "off", "rerank": r1})
+    assert three >= int8_only - 0.01, (three, int8_only)
+
+
 def test_ivfrabitq_dump_load(rng, tmp_path):
     vecs = np.random.default_rng(0).standard_normal((1200, 32)).astype(np.float32)
     eng = _mk_engine("IVFRABITQ", params={"ncentroids": 16,

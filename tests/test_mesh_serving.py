@@ -247,6 +247,55 @@ def test_mesh_probe_regime_documented_dispatch(mesh_engine):
         perf_model.DOCUMENTED_DISPATCHES["ivfpq_mesh_probe"], ledger.tags
 
 
+def test_mesh_three_stage_documented_dispatch_and_parity(rng):
+    """IVFRABITQ under a mesh: bit planes, int8 mirror and raw base
+    row-sharded in lockstep, the whole binary -> int8 -> exact chain is
+    ONE shard_map program with its own documented tag. Results are not
+    bit-identical to the single-device chain by design — each shard
+    rescores its local top-min(r0, local_n) rather than the global
+    top-r0's local slice — so the gate is ground-truth recall parity
+    within a tight band, not bit equality."""
+    from vearch_tpu.index.binary import IVFRaBitQIndex
+
+    data = rng.standard_normal((N, D)).astype(np.float32)
+
+    def build(ms):
+        params = IndexParams("IVFRABITQ", MetricType.L2, {
+            "ncentroids": 16, "train_iters": 4, "topk_mode": "exact",
+            "mesh_serving": ms,
+        })
+        store = RawVectorStore(D)
+        store.add(data)
+        idx = IVFRaBitQIndex(params, store)
+        idx.train(data[:2000])
+        idx.absorb(N)
+        return idx
+
+    solo, mesh = build("off"), build("on")
+    q = data[:8] + 0.01 * rng.standard_normal((8, D)).astype(np.float32)
+    ledger = perf_model.PerfLedger()
+    ivf_ops.set_dispatch_ledger(ledger)
+    try:
+        ms, mi = mesh.search(q, 10, None, None)
+    finally:
+        ivf_ops.set_dispatch_ledger(None)
+    assert ledger.tags == \
+        perf_model.DOCUMENTED_DISPATCHES["ivfrabitq_mesh_three_stage"], \
+        ledger.tags
+    ss, si = solo.search(q, 10, None, None)
+    # near-duplicate queries: both chains pin the true row at rank 1
+    assert (mi[:, 0] == np.arange(8)).all(), mi[:, 0]
+    assert (si[:, 0] == np.arange(8)).all(), si[:, 0]
+    d2 = ((q[:, None, :].astype(np.float64)
+           - data[None].astype(np.float64)) ** 2).sum(-1)
+    gt = np.argsort(d2, axis=1)[:, :10]
+    rec = lambda ids: np.mean([  # noqa: E731
+        len(set(ids[j].tolist()) & set(gt[j].tolist())) / 10
+        for j in range(8)])
+    assert rec(mi) >= rec(si) - 0.05, (rec(mi), rec(si))
+    assert rec(mi) >= 0.85 and rec(si) >= 0.85, (rec(mi), rec(si))
+
+
 def test_mesh_probe_regime_recall(rng):
     """The probe regime under the mesh prunes to nprobe cells — recall
     against the ungated mesh scan stays high at moderate nprobe, and

@@ -99,6 +99,16 @@ def test_profiled_soak_does_not_grow_series(cluster, rng):
                 "vearch_ps_index_health_deleted_frac",
                 "vearch_ps_index_health_unindexed_frac",
                 "vearch_ps_index_health_needs_retrain"} <= names, names
+        # progressive-refinement serving counters render their closed
+        # path/stage label sets zero-filled from the first scrape
+        assert {"vearch_ps_refine_searches_total",
+                "vearch_ps_refine_stage_rows_total"} <= names, names
+        for path in ("fused", "disk", "mesh"):
+            assert (f'vearch_ps_refine_searches_total{{path="{path}"}}'
+                    in baseline[ps.addr]), path
+        for stage in ("binary", "int8", "exact"):
+            assert (f'vearch_ps_refine_stage_rows_total{{stage="{stage}"}}'
+                    in baseline[ps.addr]), stage
     rnames = {s.split("{")[0] for s in baseline[cluster.router_addr]}
     # tail-latency series are pre-initialized (hedge events zero-filled,
     # per-node routes zero-filled at discovery): traffic, hedges and

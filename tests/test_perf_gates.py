@@ -729,6 +729,106 @@ def test_flat_footprint_is_store_only():
             store.capacity, store.dimension, store.store_dtype.itemsize))
 
 
+# -- progressive three-stage refinement (IVFRABITQ) --------------------------
+
+RABITQ_PARAMS = {
+    "ncentroids": 16, "train_iters": 4, "training_threshold": 256,
+    # single-device ledger gates (the mesh three-stage program has its
+    # own documented-dispatch gate in test_mesh_serving.py)
+    "mesh_serving": "off",
+}
+
+
+@pytest.fixture(scope="module")
+def rabitq_engine():
+    return _build("IVFRABITQ", RABITQ_PARAMS, warmup=[8])
+
+
+def test_three_stage_fused_documented_dispatch(rabitq_engine):
+    """The RAM-store three-stage search (binary scan -> int8 rescore ->
+    exact rerank) is ONE fused program; stage0=off falls back to the
+    documented int8-only fused chain."""
+    eng, vecs = rabitq_engine
+    doc = perf_model.DOCUMENTED_DISPATCHES
+    assert _search(eng, vecs).tags == doc["ivfrabitq_three_stage"]
+    assert _search(eng, vecs, index_params={"stage0": "off"}).tags == \
+        doc["ivfpq_full_fused"]
+
+
+def test_three_stage_disk_documented_dispatch(tmp_path):
+    """Against a disk store the chain splits exactly once: stages 0-1 on
+    device, stage-2 through the host readahead gather — two dispatches,
+    never a third."""
+    schema = TableSchema("t", [
+        FieldSchema("emb", DataType.VECTOR, dimension=D,
+                    index=IndexParams("IVFRABITQ", MetricType.L2,
+                                      {**RABITQ_PARAMS,
+                                       "store_type": "RocksDB"})),
+    ])
+    eng = Engine(schema, data_dir=str(tmp_path / "d"))
+    rng = np.random.default_rng(33)
+    vecs = rng.standard_normal((N, D), dtype=np.float32)
+    eng.upsert([{"_id": f"d{i:05d}", "emb": vecs[i]} for i in range(N)])
+    eng.build_index()
+    eng.wait_for_index()
+    assert _search(eng, vecs).tags == \
+        perf_model.DOCUMENTED_DISPATCHES["ivfrabitq_three_stage_disk"]
+    eng.close()
+
+
+def test_three_stage_warmed_zero_new_programs(rabitq_engine):
+    """Warmed three-stage searches — including runtime-tuned r0/r1 once
+    their shapes are traced — add ZERO compiled programs."""
+    eng, vecs = rabitq_engine
+    tuned = {"r0": 512, "r1": 64}
+    _search(eng, vecs, b=8)              # settle first-use programs
+    _search(eng, vecs, b=8, index_params=tuned)
+    before = perf_model.total_compiled_programs()
+    for _ in range(3):
+        _search(eng, vecs, b=8)
+        _search(eng, vecs, b=8, index_params=tuned)
+    assert perf_model.total_compiled_programs() == before, (
+        "warmed three-stage searches retrace per request")
+
+
+def test_binary_footprint_model_and_density_gate(rabitq_engine):
+    """Acceptance gate: the stage-0 bit planes cost <= 1/8 of the int8
+    mirror's row payload for the same capacity, the perf model and the
+    live device buffers agree byte-for-byte, and the per-row totals
+    (payload + 8B scale/vsq aux) match the documented formulas."""
+    eng, _ = rabitq_engine
+    idx = eng.indexes["emb"]
+    cap = idx._bits._h8.shape[0]
+    assert cap == idx._mirror._h8.shape[0]  # tiers grow in lockstep
+    # model-level density gate: 8x plane payload within the mirror total
+    assert 8 * perf_model.binary_plane_bytes(cap, D) <= \
+        perf_model.mirror_footprint_bytes(cap, D)
+    # model == ledger == live device buffers (the sampler's ground truth)
+    assert idx._bits.device_bytes() == \
+        perf_model.binary_footprint_bytes(cap, D)
+    planes, scale, vsq = idx._bits.flush()
+    live = planes.nbytes + scale.nbytes + vsq.nbytes
+    assert live == idx._bits.device_bytes(), (live, idx._bits.device_bytes())
+    # and the footprint model exposes the stage-0 tier to the HBM gauges
+    assert idx.device_footprint_bytes() >= (
+        idx._mirror.device_bytes() + idx._bits.device_bytes())
+
+
+def test_refine_depth_auto_defaults():
+    """refine_depths is the documented auto-tuning: r1 covers the exact
+    rerank budget (10k floor 128), r0 gives the int8 stage ~3.2x head
+    room, both clamped to the corpus."""
+    r0, r1 = perf_model.refine_depths(10, 1_000_000)
+    assert r1 == 128 and r0 == 512
+    r0, r1 = perf_model.refine_depths(100, 1_000_000)
+    assert r1 == 1000 and r0 == 3200
+    r0, r1 = perf_model.refine_depths(10, 300)   # tiny corpus clamps r0
+    assert r1 == 128 and r0 == 300
+    r0, r1 = perf_model.refine_depths(10, 64)    # r1 clamps too
+    assert r1 == 64 and r0 == 64
+    assert r0 >= r1
+
+
 # -- roofline ----------------------------------------------------------------
 
 

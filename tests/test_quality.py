@@ -199,6 +199,69 @@ def test_shadow_zero_new_programs_and_documented_dispatches(flat_engine):
     assert ledger.tags == doc * 10, ledger.tags
 
 
+def test_shadow_covers_three_stage_serving_path():
+    """Shadow-recall sampling over the progressive-refinement serving
+    path (IVFRABITQ, binary -> int8 -> exact): warm rounds add ZERO
+    compiled programs, each round launches exactly the documented
+    three-stage dispatch plus the FLAT ground truth, and the estimator
+    lands on a sane recall for the near-duplicate query stream."""
+    schema = TableSchema("t", [
+        FieldSchema("emb", DataType.VECTOR, dimension=D,
+                    index=IndexParams("IVFRABITQ", MetricType.L2,
+                                      {"ncentroids": 8,
+                                       "training_threshold": 500,
+                                       # pin the fused single-device
+                                       # program: the documented-tag
+                                       # assertion below is exact
+                                       "mesh_serving": "off"})),
+    ])
+    eng = Engine(schema)
+    rng = np.random.default_rng(23)
+    vecs = rng.standard_normal((500, D)).astype(np.float32)
+    eng.upsert([{"_id": f"d{i:04d}", "emb": vecs[i]} for i in range(500)])
+    eng.build_index()
+    eng.wait_for_index()
+    try:
+        flight_recorder.install()
+        mon = QualityMonitor(get_engines=lambda: {1: eng},
+                             sample_rate=1.0, min_samples=1)
+
+        def shadow(i):
+            res = eng.search(SearchRequest(
+                vectors={"emb": vecs[i][None, :]}, k=10,
+                include_fields=[]))
+            mon.observe_search(1, "db/q", {"emb": vecs[i]}, 10,
+                               [[it.key for it in res[0].items]],
+                               data_version=int(eng.data_version))
+            return mon.run_pending()
+
+        assert shadow(0) == 1  # cold: compiles land in warmup scope
+        flight_recorder.RECORDER.reset()
+        before = perf_model.total_compiled_programs()
+        ledger = perf_model.PerfLedger()
+        ivf_ops.set_dispatch_ledger(ledger)
+        try:
+            for i in range(1, 6):
+                assert shadow(i) == 1
+        finally:
+            ivf_ops.set_dispatch_ledger(None)
+        assert perf_model.total_compiled_programs() == before, (
+            "warm three-stage shadow rounds grew the jit cache")
+        assert flight_recorder.RECORDER.counts() == {}, (
+            "three-stage serving recorded a post-warmup compile")
+        # each round: one fused three-stage serving dispatch + the
+        # documented FLAT truth — nothing undocumented launched
+        expect = (perf_model.DOCUMENTED_DISPATCHES["ivfrabitq_three_stage"]
+                  + perf_model.DOCUMENTED_DISPATCHES["flat"]) * 5
+        assert ledger.tags == expect, ledger.tags
+        # the query IS a base row: exact rerank pins recall@10 high
+        snap = mon.recall_snapshot()["spaces"]["db/q"]
+        est = snap["recall"]["10"]["estimate"]
+        assert est is not None and est >= 0.8, snap
+    finally:
+        eng.close()
+
+
 def test_shadow_bills_quality_space_with_exact_conservation(flat_engine):
     eng, vecs = flat_engine
     accounting.install()

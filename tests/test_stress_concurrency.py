@@ -390,3 +390,75 @@ def test_diskann_absorb_search_under_lockcheck(tmp_path, rng):
         if idx is not None:
             idx.close()
         lockcheck.reset()
+
+
+def test_rabitq_absorb_binary_search_under_lockcheck(rng):
+    """Concurrent absorb + three-stage binary search, proven: a
+    realtime writer appends rows (store.add + absorb — which quantizes
+    into BOTH compressed tiers, the int8 mirror and the stage-0 bit
+    planes) while searcher threads run the fused binary -> int8 ->
+    exact chain, whose flush() races the tail-append. Under
+    VEARCH_LOCKCHECK every lock is a named DebugLock — the run must
+    leave a non-empty acquisition graph with zero inversions."""
+    from vearch_tpu.engine.raw_vector import RawVectorStore
+    from vearch_tpu.index.registry import create_index
+    from vearch_tpu.tools import lockcheck
+
+    lockcheck.reset()
+    lockcheck.enable()  # BEFORE construction: locks are minted at init
+    try:
+        base = rng.standard_normal((6000, D)).astype(np.float32)
+        store = RawVectorStore(D)
+        store.add(base[:4000])
+        p = IndexParams(
+            index_type="IVFRABITQ", metric_type=MetricType.L2,
+            params={"ncentroids": 16, "train_iters": 4,
+                    "mesh_serving": "off"},
+        )
+        idx = create_index(p, store)
+        idx.train(base[:4000])
+        idx.absorb(store.count)
+
+        errors: list[Exception] = []
+        stop = threading.Event()
+
+        def writer():
+            try:
+                for lo in range(4000, 6000, 200):
+                    store.add(base[lo:lo + 200])
+                    idx.absorb(store.count)
+            except Exception as e:
+                errors.append(e)
+            finally:
+                stop.set()
+
+        def searcher(tid: int):
+            try:
+                q = base[tid * 8:tid * 8 + 4]
+                while not stop.is_set():
+                    s, ids = idx.search(q, 5, None)
+                    assert ids.shape == (4, 5)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, name="rq-writer",
+                                    daemon=True)]
+        threads += [
+            threading.Thread(target=searcher, args=(t,),
+                             name=f"rq-search{t}", daemon=True)
+            for t in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+
+        assert not errors, errors
+        assert idx.indexed_count == 6000
+        # both compressed tiers absorbed every row in lockstep
+        assert idx._bits._n == idx._mirror._n == 6000
+        edges = lockcheck.acquisition_edges()
+        assert edges, "lockcheck recorded no lock activity"
+        lockcheck.check()  # raises listing any inversion / guarded write
+    finally:
+        lockcheck.reset()

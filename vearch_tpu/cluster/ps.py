@@ -626,6 +626,27 @@ class PSServer:
                            lambda: {(e,): float(n) for e, n in
                                     self._quality.counters().items()})
 
+        # progressive-refinement serving: fixed label topology straight
+        # from the ops-layer counters (ops/binary_scan.py), zero-filled
+        # from first scrape — path/stage sets are module constants, so
+        # the series count is flat regardless of traffic
+        from vearch_tpu.ops import binary_scan as _binary_scan
+
+        m.callback_counter("vearch_ps_refine_searches_total",
+                           "three-stage (binary->int8->exact) searches "
+                           "served, by serving path",
+                           ("path",),
+                           lambda: {(p,): float(n) for p, n in
+                                    _binary_scan.refine_search_counts()
+                                    .items()})
+        m.callback_counter("vearch_ps_refine_stage_rows_total",
+                           "candidate rows scored per refinement stage "
+                           "(binary=full scan, int8=r0, exact=r1)",
+                           ("stage",),
+                           lambda: {(s,): float(n) for s, n in
+                                    _binary_scan.refine_stage_rows()
+                                    .items()})
+
         def _health_gauge(metric: str, field_level: bool):
             def read():
                 h = self._quality.health_snapshot()

@@ -120,6 +120,10 @@ def _compat_key(req: "SearchRequest", tiered: bool = True) -> str:
         "fields": sorted(req.vectors),
         "k": perf_model.bucket_fetch_k(_request_fetch_k(req))
         if mix_k else req.k,
+        # index_params covers every shape-bearing serving knob — notably
+        # the three-stage refinement depths r0/r1 (static args of the
+        # binary_refine programs): requests tuned to different depths
+        # land in different buckets instead of silently sharing one
         "params": req.index_params or {},
         "weights": req.field_weights or {},
         "include": sorted(req.include_fields)

@@ -161,6 +161,14 @@ class Engine:
         # optional terminal-state sink (PS wires build-duration
         # histograms through it; covers background auto-builds too)
         self.build_observer = None
+        # optional staleness sink for the search-quality layer (lint
+        # VL105): fired on every wholesale index replacement — retrain
+        # rebuilds the compressed serving tiers (int8 mirror AND the
+        # stage-0 bit planes) in place, so queued shadow samples must
+        # not be scored against the pre-rebuild snapshot. The PS resets
+        # its QualityMonitor through build_observer; embedded users
+        # (bench, SDK-local engines) wire this directly.
+        self.mutation_observer = None
         self._write_lock = threading.Lock()
         # monotone data version: bumped under _write_lock by every
         # mutation that can change search results (upsert, delete,
@@ -1019,6 +1027,17 @@ class Engine:
                 done.setdefault(name, []).append(b)
         return done
 
+    def note_index_mutation(self, op: str = "") -> None:
+        """Staleness hook (lint VL105): forward a wholesale index
+        replacement to the wired quality observer. Safe at any
+        frequency; observability must never fail the mutation."""
+        obs = self.mutation_observer
+        if obs is not None:
+            try:
+                obs(op)
+            except Exception:
+                pass
+
     def rebuild_index(self) -> None:
         """Retrain from scratch (reference: engine.cc:1007 RebuildIndex)."""
         for name, index in self.indexes.items():
@@ -1027,6 +1046,9 @@ class Engine:
             self.indexes[name] = create_index(params, store)
         self.status = IndexStatus.UNINDEXED
         self.build_index(op="rebuild")
+        # the retrain replaced the quantizers, the int8 mirror AND the
+        # stage-0 bit planes wholesale
+        self.note_index_mutation(op="rebuild")
 
     def _training_threshold(self, index: VectorIndex) -> int:
         """Docs required before auto-build starts; explicit build_index()
@@ -1313,6 +1335,10 @@ class Engine:
         spans.extend(
             [f"tier.{name}", mono_us(t0), int((t1 - t0) * 1e6)]
             for name, t0, t1 in capture.tier_phases
+        )
+        spans.extend(
+            [f"stage.{name}", mono_us(t0), int((t1 - t0) * 1e6)]
+            for name, t0, t1 in capture.stage_phases
         )
         trace["_phase_spans"] = spans
         if capture.mesh_phases or any(t.startswith("sharded") for t in tags):

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from vearch_tpu.ops import perf_model
+from vearch_tpu.tools import lockcheck
 
 
 def quantize_rows(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -55,18 +56,26 @@ class Int8Mirror:
     - "int8" (default): 1 byte/dim, ~0.8% row-max quantization error;
     - "int4": 0.5 byte/dim — HALF the resident HBM per row (the usual
       rows-per-chip limiter), ~7% row-max error that the exact rerank
-      stage absorbs.
+      stage absorbs;
+    - "bits": 1 BIT/dim packed sign planes (ops/binary_scan.py
+      pack_sign_rows) — the stage-0 tier of the progressive refinement
+      chain, 8x denser than int8's row payload; selection-grade scores
+      that the int8 + exact refinement stages restore.
     """
 
     def __init__(self, dimension: int, storage: str = "int8"):
         self.dimension = dimension
         self.storage = str(storage).lower()
-        if self.storage not in ("int8", "int4"):
+        if self.storage not in ("int8", "int4", "bits"):
             raise ValueError(f"unknown mirror storage {storage!r}")
         if self.storage == "int4" and dimension % 2 != 0:
             raise ValueError("int4 mirror storage needs an even dimension")
-        width = dimension if self.storage == "int8" else dimension // 2
-        dt = np.int8 if self.storage == "int8" else np.uint8
+        if self.storage == "int8":
+            width, dt = dimension, np.int8
+        elif self.storage == "int4":
+            width, dt = dimension // 2, np.uint8
+        else:  # bits: byte-padded packed sign planes
+            width, dt = -(-dimension // 8), np.uint8
         self._row_width = width
         self._row_dtype = dt
         self._h8 = np.zeros((0, width), dtype=dt)
@@ -77,6 +86,11 @@ class Int8Mirror:
         self._d_scale: jax.Array | None = None
         self._d_vsq: jax.Array | None = None
         self._d_rows = 0
+        # append vs flush race: a concurrent append may REPLACE the
+        # host arrays (capacity growth) while flush reads them — the
+        # tail-flush would mix old and new buffers. One leaf lock
+        # serializes host-array mutation against device placement.
+        self._flush_lock = lockcheck.make_lock("mirror_flush")
 
     @property
     def count(self) -> int:
@@ -94,6 +108,13 @@ class Int8Mirror:
         start: int | None = None,
     ) -> None:
         """Write rows at [start, start+b) (default: append at count)."""
+        with self._flush_lock:
+            self._append_locked(q8, scale, vsq, start)
+
+    def _append_locked(
+        self, q8: np.ndarray, scale: np.ndarray, vsq: np.ndarray,
+        start: int | None,
+    ) -> None:
         start = self._n if start is None else start
         need = start + q8.shape[0]
         if self._h8.shape[0] < need:
@@ -121,9 +142,15 @@ class Int8Mirror:
             self._sh_cache.lower_rows(start)
 
     def append(self, rows: np.ndarray, start: int | None = None) -> None:
-        quant = (
-            quantize_rows if self.storage == "int8" else quantize_rows_int4
-        )
+        if self.storage == "bits":
+            from vearch_tpu.ops.binary_scan import pack_sign_rows
+
+            quant = pack_sign_rows
+        else:
+            quant = (
+                quantize_rows if self.storage == "int8"
+                else quantize_rows_int4
+            )
         self.append_quantized(*quant(rows), start=start)
 
     def flush_sharded(self, mesh) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -157,13 +184,18 @@ class Int8Mirror:
                 np.ascontiguousarray(self._h_vsq[lo:hi]),
             )
 
-        arrays, _ = self._sh_cache.get(mesh, self._n, build, append)
+        with self._flush_lock:
+            arrays, _ = self._sh_cache.get(mesh, self._n, build, append)
         return arrays
 
     _sh_cache = None
 
     def flush(self) -> tuple[jax.Array, jax.Array, jax.Array]:
         """Device views [cap, d] / [cap] / [cap]; rows >= count are padding."""
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> tuple[jax.Array, jax.Array, jax.Array]:
         n = self._n
         cap = self._h8.shape[0]
         if self._d8 is None or self._d8.shape[0] != cap:

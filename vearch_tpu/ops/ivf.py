@@ -82,7 +82,7 @@ _capture_tls = threading.local()
 
 
 class DispatchCapture:
-    __slots__ = ("events", "mesh_phases", "tier_phases")
+    __slots__ = ("events", "mesh_phases", "tier_phases", "stage_phases")
 
     def __init__(self) -> None:
         # [tag, start_monotonic_s, end_monotonic_s | None] — consumers
@@ -97,6 +97,11 @@ class DispatchCapture:
         # of the tiered-storage path (demand fetch, prefetch schedule,
         # pin-set change) — replayed as tier.{name} phase spans
         self.tier_phases: list[tuple[str, float, float]] = []
+        # (name, start_monotonic_s, end_monotonic_s) host-side windows
+        # of the progressive-refinement path (bit-plane/mirror flush,
+        # the fused refine dispatch, the disk stage-2 gather+rerank) —
+        # replayed as stage.{name} phase spans
+        self.stage_phases: list[tuple[str, float, float]] = []
 
     def note(self, tag: str) -> None:
         now = time.monotonic()
@@ -164,6 +169,16 @@ def note_tier_phase(name: str, t0: float, t1: float) -> None:
     cap = getattr(_capture_tls, "capture", None)
     if cap is not None:
         cap.tier_phases.append((name, t0, t1))
+
+
+def note_stage_phase(name: str, t0: float, t1: float) -> None:
+    """Record a host-side window of the progressive-refinement serving
+    path (index/binary.py three-stage chain) on the current request's
+    capture — shows up as a stage.{name} phase span next to the
+    kernel.* dispatch spans. No-op without an installed capture."""
+    cap = getattr(_capture_tls, "capture", None)
+    if cap is not None:
+        cap.stage_phases.append((name, t0, t1))
 
 
 def _coarse_probes(

@@ -132,6 +132,17 @@ DOCUMENTED_DISPATCHES: dict[str, list[str]] = {
     "ivfpq_mesh_probe": ["sharded_probe_scan_rerank"],
     # FLAT over the mesh: one fused scan+all_gather+re-top-k program
     "flat_sharded": ["sharded_flat_scan"],
+    # progressive three-stage refinement (IVFRABITQ, RAM store): binary
+    # stage-0 scan + int8 rescore + exact rerank fused into ONE program
+    "ivfrabitq_three_stage": ["binary_refine_rerank"],
+    # three-stage over a disk store: stages 0-1 on device, stage-2 rows
+    # host-gathered through the mmap + readahead path (same rerank
+    # dispatch the int8 disk path pays)
+    "ivfrabitq_three_stage_disk": ["binary_refine_scan", "rerank"],
+    # three-stage over the mesh: per-shard stages 0-1, one all_gather
+    # candidate merge, sharded exact rerank + pmax — ONE shard_map
+    # program (parallel/sharded.py sharded_binary_refine)
+    "ivfrabitq_mesh_three_stage": ["sharded_binary_refine_rerank"],
 }
 
 
@@ -467,6 +478,51 @@ def mirror_footprint_bytes(n_cap: int, d: int, storage: str = "int8") -> int:
     rows + per-row scale + per-row ||v||^2 (index/int8_mirror.py)."""
     width = d if storage == "int8" else (d + 1) // 2
     return n_cap * width + 2 * n_cap * F32
+
+
+def binary_plane_bytes(n_cap: int, d: int) -> int:
+    """Row PAYLOAD of the packed bit-plane mirror: ceil(d/8) bytes per
+    row at the 512-aligned capacity. This — not the total — is the
+    8x-density gate against the int8 mirror: the per-row aux columns
+    (scale + offset, 8 bytes) ride identically on BOTH tiers, so the
+    honest density claim compares payloads:
+    8 * binary_plane_bytes <= mirror_footprint_bytes holds for every d
+    (the int8 total is d + 8 bytes/row vs the plane's d/8), while the
+    TOTAL ratio (d/8 + 8) / (d + 8) only approaches 1/8 as d grows —
+    tests/test_perf_gates.py gates the payload form and PERF.md Tier 8
+    states both numbers."""
+    return int(n_cap) * (-(-int(d) // 8))
+
+
+def binary_footprint_bytes(n_cap: int, d: int) -> int:
+    """Resident device bytes of the flushed bit-plane mirror: packed
+    sign planes + per-row scale + per-row ||approx||^2 — what
+    Int8Mirror(storage="bits").device_bytes() reports and the device
+    sampler must agree with."""
+    return binary_plane_bytes(n_cap, d) + 2 * int(n_cap) * F32
+
+
+def binary_scan_traffic_bytes(n_pad: int, d: int) -> int:
+    """HBM bytes the stage-0 pass READS per query batch: each packed
+    plane exactly once — 1/8 of the int8 scan's traffic term, the
+    bandwidth headroom that makes stage 0 worth a third stage."""
+    return int(n_pad) * (-(-int(d) // 8))
+
+
+def refine_depths(k: int, n: int) -> tuple[int, int]:
+    """Auto defaults for the three-stage candidate depths (r0, r1).
+
+    Stage 0's sign estimator is selection-grade only, so its survivor
+    set must be generous: r0 = 32x the int8 default's 10x-k rule,
+    floored at 512 (one block-max block) — still ~1e-3 of a 1M-row
+    partition. Stage 1 then funnels to the proven int8 rerank depth
+    r1 = max(10k, 128). Both clamp to the row count; both are
+    runtime-tunable per request / via /ps/engine/config ("r0"/"r1"
+    index params) with these as the documented fallback."""
+    n = max(int(n), 1)
+    r1 = min(max(10 * int(k), 128), n)
+    r0 = min(max(32 * r1 // 10, 512), n)
+    return max(r0, r1), r1
 
 
 def raw_store_footprint_bytes(

@@ -345,6 +345,113 @@ def _ivf_search_fn(
     )
 
 
+def sharded_binary_refine(
+    mesh: Mesh,
+    planes: jax.Array,       # [N_pad, d/8] uint8 sharded P("data", None)
+    p_scale: jax.Array,      # [N_pad] f32 sharded P("data")
+    p_vsq: jax.Array,        # [N_pad] f32 sharded P("data")
+    approx8: jax.Array,      # [N_pad, d] int8 / [N_pad, d/2] int4-packed
+    m_scale: jax.Array,      # [N_pad] f32 sharded P("data")
+    m_vsq: jax.Array,        # [N_pad] f32 sharded P("data")
+    valid: jax.Array,        # [N_pad] bool sharded P("data")
+    base: jax.Array,         # [cap, d] raw rows sharded P("data", None)
+    base_sqnorm: jax.Array,  # [cap] f32 sharded P("data")
+    queries: jax.Array,      # [B_pad, d] f32 sharded P("query", None)
+    r0: int,
+    r1: int,
+    k: int,
+    scan_metric: MetricType = MetricType.L2,
+    rerank_metric: MetricType = MetricType.L2,
+    topk_mode: str = "auto",
+    storage: str = "int8",
+) -> tuple[jax.Array, jax.Array]:
+    """The pod-slice three-stage refinement program: bit planes, int8
+    mirror, and raw base all row-sharded in lockstep over "data"
+    (identical ShardedRowCache alignment, so local row offsets agree);
+    stages 0-1 run entirely shard-local — a shard's stage-0 survivors
+    are by construction rows it owns, so the int8 rescore needs no
+    collective — then ONE all_gather merges the per-shard top-r1 sets
+    and the exact rerank + pmax merge finishes exactly like
+    sharded_ivf_search. ONE jitted shard_map program end to end."""
+    return _binary_refine_fn(
+        mesh, r0, r1, k, scan_metric, rerank_metric, topk_mode, storage
+    )(planes, p_scale, p_vsq, approx8, m_scale, m_vsq, valid,
+      base, base_sqnorm, queries)
+
+
+@functools.lru_cache(maxsize=128)
+def _binary_refine_fn(
+    mesh: Mesh, r0: int, r1: int, k: int, scan_metric: MetricType,
+    rerank_metric: MetricType, topk_mode: str, storage: str,
+):
+    from vearch_tpu.ops.binary_scan import _binary_scores, _mirror_rescore
+    from vearch_tpu.ops.ivf import _select_topk
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("data", None), P("data"), P("data"),
+            P("data", None), P("data"), P("data"), P("data"),
+            P("data", None), P("data"), P("query", None),
+        ),
+        out_specs=(P("query", None), P("query", None)),
+        check_rep=False,
+    )
+    def run(pl, psc, pvsq, a8, msc, mvsq, v, b, bsqn, q):
+        local_n = psc.shape[0]
+        # stage 0: local binary scan over this shard's bit planes
+        scores = _binary_scores(q, pl, psc, pvsq, v, scan_metric)
+        _, c0 = _select_topk(scores, min(r0, local_n), topk_mode)
+        # stage 1: rescore this shard's own survivors against its
+        # int8/int4 mirror slab — ids are still shard-local
+        top_s, top_i = _mirror_rescore(
+            q, c0, a8, msc, mvsq, min(r1, local_n), scan_metric, storage
+        )
+        shard = jax.lax.axis_index("data")
+        gids = jnp.where(top_i >= 0, top_i + shard * local_n, -1)
+        all_s = jax.lax.all_gather(top_s, "data", axis=1, tiled=True)
+        all_i = jax.lax.all_gather(gids, "data", axis=1, tiled=True)
+        rr = min(r1, all_s.shape[1])
+        cand_s, pos = jax.lax.top_k(all_s, rr)
+        cand_i = jnp.take_along_axis(all_i, pos, axis=1)
+        # stage 2: exact rerank against the shard's raw slab, pmax
+        # ownership merge (same math as _ivf_search_fn's tail)
+        local_nb = b.shape[0]
+        local = cand_i - shard * local_nb
+        mine = (cand_i >= 0) & (local >= 0) & (local < local_nb)
+        safe = jnp.clip(local, 0, local_nb - 1)
+        vecs = b[safe]  # [B, rr, d]
+        bvsq = bsqn[safe]
+        qf = q.astype(b.dtype)
+        rdots = jax.lax.dot_general(
+            qf, vecs, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+            precision=dot_precision(qf, vecs),
+        )
+        if rerank_metric is MetricType.L2:
+            rscores = -(sqnorms(qf)[:, None] - 2.0 * rdots + bvsq)
+        elif rerank_metric is MetricType.COSINE:
+            qn = jnp.sqrt(jnp.maximum(sqnorms(qf), 1e-30))[:, None]
+            vn = jnp.sqrt(jnp.maximum(bvsq, 1e-30))
+            rscores = rdots / (qn * vn)
+        else:
+            rscores = rdots
+        rscores = jnp.where(mine, rscores, NEG_INF)
+        rscores = jax.lax.pmax(rscores, "data")
+        kk = min(k, rscores.shape[1])
+        out_s, out_pos = jax.lax.top_k(rscores, kk)
+        out_i = jnp.take_along_axis(cand_i, out_pos, axis=1)
+        return out_s, jnp.where(jnp.isfinite(out_s), out_i, -1)
+
+    return register_jit(
+        f"sharded.binary_refine[{_mesh_tag(mesh)},r0_{r0},r1_{r1},k{k},"
+        f"{scan_metric.name},{rerank_metric.name},{topk_mode},{storage}]",
+        run,
+    )
+
+
 def sharded_kmeans_step(
     mesh: Mesh,
     x: jax.Array,        # [N_pad, d] sharded P("data", None)

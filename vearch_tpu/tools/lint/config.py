@@ -104,6 +104,10 @@ VL104_BILLABLE_COUNTERS = ("_killed_total", "_shed_total")
 # path suffix in the files that own index mutation.
 VL105_QUALITY_FILES = (
     "vearch_tpu/cluster/ps.py",
+    # the engine owns the bit-plane / mirror rebuild paths directly:
+    # rebuild_index replaces every compressed serving tier in place,
+    # so engine-embedded users (bench, SDK-local) need the hook too
+    "vearch_tpu/engine/engine.py",
 )
 # attribute-call names that replace index contents wholesale
 VL105_INDEX_MUTATORS = ("build_index", "rebuild_index")
