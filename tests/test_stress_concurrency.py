@@ -2,6 +2,7 @@
 engine (the parity answer to TSAN-style CI the reference lacks too —
 SURVEY §5 race detection)."""
 
+import os
 import threading
 
 import numpy as np
@@ -12,6 +13,36 @@ from vearch_tpu.engine.types import (
 )
 
 D = 16
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "vearch_tpu")
+
+_STATIC_LOCK_GRAPH = None
+
+
+def _assert_static_covers(edges):
+    """ISSUE 20 truth link: every (first, then) acquisition edge the
+    runtime lockcheck recorder observed must be covered by the static
+    lock-order graph (`lint --lock-graph`). A runtime edge the
+    analyzer cannot see is a resolution blind spot to fix — the
+    static cycle-freedom proof only binds if the runtime behavior is
+    inside the proved graph. Computed in-process once per session."""
+    global _STATIC_LOCK_GRAPH
+    from vearch_tpu.tools.lint import callgraph
+    from vearch_tpu.tools.lint.core import run_paths
+
+    if _STATIC_LOCK_GRAPH is None:
+        run_paths([PKG])  # builds callgraph.LAST as a side effect
+        assert callgraph.LAST is not None
+        _STATIC_LOCK_GRAPH = callgraph.LAST.lock_graph_artifact()
+    assert _STATIC_LOCK_GRAPH["cycles"] == []
+    uncovered = sorted(
+        (a, b) for (a, b) in edges
+        if not callgraph.edge_covered(_STATIC_LOCK_GRAPH, a, b))
+    assert not uncovered, (
+        "runtime acquisition edges missing from the static lock-order "
+        f"graph (analyzer blind spot): {uncovered}")
 
 
 def test_concurrent_upsert_search_delete(rng):
@@ -182,6 +213,7 @@ def test_cluster_stress_under_lockcheck(tmp_path, rng):
         edges = lockcheck.acquisition_edges()
         assert edges, "no DebugLock edges recorded — lockcheck inert?"
         lockcheck.check()  # zero inversions / unguarded writes / misuse
+        _assert_static_covers(edges)
     finally:
         if router is not None:
             router.stop()
@@ -293,6 +325,7 @@ def test_concurrent_split_under_lockcheck(tmp_path, rng):
         edges = lockcheck.acquisition_edges()
         assert edges, "no DebugLock edges recorded — lockcheck inert?"
         lockcheck.check()  # zero inversions / unguarded writes / misuse
+        _assert_static_covers(edges)
         # the health rollup is heartbeat-fed, so it drains within a
         # beat of the parent's retirement
         import time as _time
@@ -386,6 +419,7 @@ def test_diskann_absorb_search_under_lockcheck(tmp_path, rng):
         edges = lockcheck.acquisition_edges()
         assert edges, "lockcheck recorded no lock activity"
         lockcheck.check()  # raises listing any inversion / guarded write
+        _assert_static_covers(edges)
     finally:
         if idx is not None:
             idx.close()
@@ -460,5 +494,6 @@ def test_rabitq_absorb_binary_search_under_lockcheck(rng):
         edges = lockcheck.acquisition_edges()
         assert edges, "lockcheck recorded no lock activity"
         lockcheck.check()  # raises listing any inversion / guarded write
+        _assert_static_covers(edges)
     finally:
         lockcheck.reset()

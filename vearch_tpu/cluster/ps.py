@@ -2093,6 +2093,7 @@ class PSServer:
                         rem = end - time.monotonic()
                         if rem <= 0:
                             break
+                        # lint: allow[serving-blocking] env-gated test-only delay, sliced 5ms so ctx.check() keeps it killable
                         time.sleep(min(0.005, rem))
                 # apply version captured BEFORE the search runs: a
                 # write landing mid-search makes the resulting cache

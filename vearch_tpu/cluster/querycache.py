@@ -54,6 +54,7 @@ def canonical_query_key(
     h = hashlib.sha256()
     h.update(space.encode())
     for name in sorted(vectors):
+        # lint: allow[host-sync] canonicalises the (host) query payload for byte-exact hashing, no device involved
         arr = np.ascontiguousarray(np.asarray(vectors[name], np.float32))
         h.update(name.encode())
         h.update(str(arr.shape).encode())

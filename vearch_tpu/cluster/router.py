@@ -712,10 +712,32 @@ class RouterServer:
         # whitelist below: a deadline/operator kill is terminal, and a
         # retry would re-run the exact work the kill was meant to shed.
         last: RpcError | None = None
+        # Thread the client deadline into the transport: each attempt's
+        # HTTP timeout is the remaining budget plus a grace window. The
+        # PS-side killer is the deadline ENFORCER (it answers 499, which
+        # is terminal below); the transport bound is only the safety net
+        # for a PS too hung to answer at all, so it must fire strictly
+        # AFTER the kill would — a timeout equal to the budget races the
+        # 499 and the whitelisted -1 it produces would mask the kill and
+        # re-run killed work as failover. Without a deadline the
+        # transport default still bounds every attempt.
+        dl_ms = body.get("deadline_ms")
+        deadline = (time.monotonic() + float(dl_ms) / 1e3) if dl_ms else None
+        grace = 2.0
         for attempt in range(6):
             if attempt:
                 self._invalidate_caches()
-                time.sleep(0.3 * attempt)
+                delay = 0.3 * attempt
+                if deadline is not None:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0.0:
+                        raise last or RpcError(
+                            ERR_REQUEST_KILLED,
+                            "request_killed: deadline exhausted during "
+                            "failover retry")
+                    delay = min(delay, budget)
+                # lint: allow[serving-blocking] bounded failover backoff, clamped to the request's remaining deadline budget
+                time.sleep(delay)
             node = -1
             try:
                 space = self._space(*space_key)
@@ -740,8 +762,18 @@ class RouterServer:
                 with self._route_lock:
                     self._route_counts[node] = (
                         self._route_counts.get(node, 0) + 1)
+                timeout = 120.0
+                if deadline is not None:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0.0:
+                        raise last or RpcError(
+                            ERR_REQUEST_KILLED,
+                            "request_killed: deadline exhausted before "
+                            "partition RPC")
+                    timeout = min(timeout, budget + grace)
                 out = rpc.call(addr, "POST", path,
-                               {**body, "partition_id": pid})
+                               {**body, "partition_id": pid},
+                               timeout=timeout)
                 with self._cache_lock:
                     self._faulty.pop(node, None)  # proven healthy
                 return out
@@ -940,8 +972,12 @@ class RouterServer:
                 srv = self._servers().get(node)
                 if srv is None:
                     return
+                # bounded best-effort: a cancel that cannot land in 5s
+                # is not worth holding a thread for — the PS deadline
+                # reaps the attempt anyway
                 out = rpc.call(srv.rpc_addr, "POST", "/ps/kill",
-                               {"request_id": rid, "attempt": att})
+                               {"request_id": rid, "attempt": att},
+                               timeout=5.0)
                 if out.get("killed"):
                     self._hedge_note("cancelled")
             except RpcError:
@@ -973,9 +1009,14 @@ class RouterServer:
         has_permission(record.get("role", ""),
                        record.get("privileges") or {}, path, method)
 
-    def _master_call(self, method: str, path: str, body=None):
+    def _master_call(self, method: str, path: str, body=None,
+                     timeout: float = 30.0):
+        # metadata/admin calls get an explicit 30s bound: a wedged
+        # master must fail serving-path metadata fetches fast enough
+        # for the cached copy + failover retry to take over, not pin
+        # request threads for the transport default
         return rpc.call(self.master_addr, method, path, body,
-                        auth=self.master_auth)
+                        timeout=timeout, auth=self.master_auth)
 
     def _proxy_master(self, method: str, prefix: str):
         def h(body, parts):
@@ -988,7 +1029,9 @@ class RouterServer:
                 q = body.pop("_query")
                 path += "?" + urlencode(q)
                 body = body or None
-            return self._master_call(method, path, body)
+            # proxied admin ops (space create, backup) keep the full
+            # transport budget; only serving-path metadata is tight
+            return self._master_call(method, path, body, timeout=120.0)
 
         return h
 
@@ -1268,6 +1311,7 @@ class RouterServer:
         nq = None
         for v in body.get("vectors", []):
             f = space.schema.field(v["field"])
+            # lint: allow[host-sync] host-side wire-payload decode (JSON floats -> np), no device involved
             feat = np.asarray(v["feature"], dtype=np.float32).ravel()
             wd = max(f.wire_dim, 1)
             if feat.shape[0] % wd != 0:
@@ -1651,6 +1695,7 @@ class RouterServer:
             out = {
                 "columnar": True,
                 "keys": [[r["_id"] for r in rows] for rows in merged],
+                # lint: allow[host-sync] packs merged host floats for the columnar wire codec, no device involved
                 "scores": np.asarray(
                     [r["_score"] for rows in merged for r in rows],
                     dtype=np.float32,
@@ -1690,6 +1735,7 @@ class RouterServer:
             # numpy until only the final top-k becomes Python objects
             sliced = []
             for p in partials:
+                # lint: allow[host-sync] wraps the wire-decoded score buffer (already host memory), no device involved
                 flat = np.asarray(p["scores"])
                 offs = np.cumsum([0] + [len(ks) for ks in p["keys"]])
                 sliced.append([
@@ -1789,6 +1835,7 @@ class RouterServer:
         consumes."""
         import numpy as np
 
+        # lint: allow[host-sync] wraps the wire-decoded score buffer (already host memory), no device involved
         flat = np.asarray(p["scores"])
         offs = np.cumsum([0] + [len(ks) for ks in p["keys"]])
         results = [

@@ -570,8 +570,9 @@ def call(
         last: RpcError | None = None
         for a in addr.split(","):
             try:
-                return call(a.strip(), method, path, body, timeout, auth,
-                            extra_headers)
+                return call(a.strip(), method, path, body,
+                            timeout=timeout, auth=auth,
+                            extra_headers=extra_headers)
             except RpcError as e:
                 if e.code not in (-1, 503):
                     raise
@@ -637,6 +638,7 @@ def _pooled_request(addr, method, path, data, headers, timeout):
         if conn.sock is None:
             conn.timeout = timeout  # not the timeout it was created with
             try:
+                # lint: allow[serving-blocking] the transport boundary itself, bounded by the caller's timeout set just above
                 conn.connect()
             except OSError as e:
                 conn.close()

@@ -139,6 +139,42 @@ def _check_obs_docs() -> tuple[bool | None, str]:
     return True, "docs match source"
 
 
+_LINT_CLEAN_MEMO: tuple[bool | None, str] | None = None
+
+
+def _check_lint_clean() -> tuple[bool | None, str]:
+    """The full vearch-lint suite — including the interprocedural
+    VL5xx serving-path/lock-graph proofs — run in-process over the
+    installed source tree. (None, reason) when the tree is not
+    available (doctor from a bare wheel against a remote cluster).
+    Memoized for the process: doctor can run many times per session
+    and the whole-package scan costs seconds; the source tree does not
+    change under a running process."""
+    global _LINT_CLEAN_MEMO
+    if _LINT_CLEAN_MEMO is not None:
+        return _LINT_CLEAN_MEMO
+    import os
+
+    from vearch_tpu.tools.lint import (
+        Allowlist, default_allowlist_path, run_paths,
+    )
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(pkg_root, "tools", "lint")):
+        return None, "source tree not present; skipped"
+    findings = run_paths(
+        [pkg_root], allowlist=Allowlist(default_allowlist_path()))
+    hard = [f for f in findings if not f.suppressed]
+    if hard:
+        out = (False, "; ".join(
+            f"{f.rule}[{f.tag}] {f.path}:{f.line}" for f in hard[:5]))
+    else:
+        allowed = sum(1 for f in findings if f.suppressed)
+        out = (True, f"0 hard finding(s), {allowed} reason-waived")
+    _LINT_CLEAN_MEMO = out
+    return out
+
+
 def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
     """Evaluate the standing invariants over a collected report."""
     checks: list[dict[str, Any]] = []
@@ -370,6 +406,17 @@ def run_checks(report: dict[str, Any]) -> list[dict[str, Any]]:
         ok, detail = None, f"obs-docs check unavailable: {e}"
     checks.append({
         "name": "obs_docs",
+        "ok": True if ok is None else ok,
+        "skipped": ok is None,
+        "detail": detail,
+    })
+
+    try:
+        ok, detail = _check_lint_clean()
+    except Exception as e:
+        ok, detail = None, f"lint check unavailable: {e}"
+    checks.append({
+        "name": "lint_clean",
         "ok": True if ok is None else ok,
         "skipped": ok is None,
         "detail": detail,

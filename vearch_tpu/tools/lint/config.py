@@ -39,9 +39,9 @@ SERVING_PATH_FUNCTIONS = {
     ("vearch_tpu/engine/engine.py", "Engine._search_direct"),
     ("vearch_tpu/cluster/ps.py", "PSServer._h_search"),
     ("vearch_tpu/cluster/ps.py", "PSServer._do_search"),
-    ("vearch_tpu/cluster/router.py", "Router._h_search"),
-    ("vearch_tpu/cluster/router.py", "Router._search_impl"),
-    ("vearch_tpu/cluster/router.py", "Router._search_scatter"),
+    ("vearch_tpu/cluster/router.py", "RouterServer._h_search"),
+    ("vearch_tpu/cluster/router.py", "RouterServer._search_impl"),
+    ("vearch_tpu/cluster/router.py", "RouterServer._search_scatter"),
 }
 
 HOST_SYNC_METHODS = {"block_until_ready", "item"}
@@ -121,3 +121,97 @@ MUTATOR_METHODS = {
     "popitem", "clear", "update", "setdefault", "move_to_end",
     "appendleft", "popleft",
 }
+
+# -- VL501–VL504 interprocedural serving-path analysis ------------------------
+# Entry points the whole-program call graph is rooted at:
+# (path suffix, function qualname, kind). "search" marks the
+# latency-critical read path (VL502 blocking and VL504 deadline rules
+# apply); "write" marks ingest/apply paths (VL501 dispatch hygiene
+# only — writes tolerate I/O by design, raft/WAL *are* I/O).
+INTERPROC_ENTRY_POINTS = (
+    ("vearch_tpu/cluster/router.py", "RouterServer._h_search", "search"),
+    ("vearch_tpu/cluster/ps.py", "PSServer._h_search", "search"),
+    ("vearch_tpu/engine/engine.py", "Engine.search", "search"),
+    # the continuous-batching dispatch thread serves queued searches
+    ("vearch_tpu/engine/batching.py", "BatchScheduler._loop", "search"),
+    ("vearch_tpu/cluster/router.py", "RouterServer._h_upsert", "write"),
+    ("vearch_tpu/cluster/router.py", "RouterServer._h_delete", "write"),
+    ("vearch_tpu/cluster/ps.py", "PSServer._h_upsert", "write"),
+    ("vearch_tpu/cluster/ps.py", "PSServer._h_delete", "write"),
+    ("vearch_tpu/engine/engine.py", "Engine.upsert", "write"),
+    ("vearch_tpu/engine/engine.py", "Engine.delete", "write"),
+    # raft/WAL observer callbacks run on the apply thread; their
+    # closures are reachable through the closure rule
+    ("vearch_tpu/cluster/ps.py", "PSServer._raft_observer", "write"),
+    ("vearch_tpu/cluster/ps.py", "PSServer._wal_observer", "write"),
+)
+
+# Ubiquitous method names whose name-based fan-out would connect every
+# class in the project; calls on untyped receivers with these names
+# land in the unresolved bucket instead of fanning out.
+FANOUT_STOPLIST = {
+    "get", "put", "pop", "add", "append", "extend", "remove", "discard",
+    "clear", "update", "setdefault", "items", "keys", "values", "copy",
+    "close", "start", "stop", "join", "wait", "set", "reset", "acquire",
+    "release", "read", "write", "send", "recv", "open", "flush", "load",
+    "save", "notify", "notify_all", "count", "index", "sort", "split",
+    "strip", "encode", "decode", "format", "lower", "upper", "popleft",
+    "appendleft", "info", "debug", "warning", "error", "exception",
+}
+
+# Layers that sit ABOVE the cluster (clients of it): excluded from
+# name-based fan-out so VearchClient.search cannot be mistaken for a
+# callee of Engine.search.
+INTERPROC_FANOUT_EXCLUDE = ("vearch_tpu/sdk/",)
+
+# Packages whose host-device syncs are their own business (VL502's
+# host-sync subset): the device layers and the CPU-side index/scalar
+# data structures materialise arrays by design. The blocking-I/O
+# subset is exempt NOWHERE — an open()/sleep()/socket reachable from
+# a search handler needs a justification wherever it lives.
+VL502_SYNC_EXEMPT_PACKAGES = DISPATCH_PACKAGES + (
+    "vearch_tpu/index/",
+    "vearch_tpu/scalar/",
+)
+
+# Blocking primitives: bare-name calls that resolve to nothing in the
+# project (true builtins/externals)...
+VL502_BLOCKING_BARE = {"open", "urlopen"}
+# ...module-qualified calls (module -> functions; None = any)...
+VL502_BLOCKING_MODULES = {
+    "time": {"sleep"},
+    "socket": None,
+    "select": None,
+    "subprocess": {"run", "Popen", "check_call", "check_output", "call"},
+    "mmap": {"mmap"},
+    "os": {"read", "write", "fsync", "system", "popen", "sendfile"},
+    "urllib.request": {"urlopen"},
+    "requests": None,
+    "numpy": {"memmap"},
+}
+# ...and methods on receivers the resolver could not type (file/socket
+# handles reaching the serving path through parameters).
+VL502_BLOCKING_METHODS = {
+    "recv", "recv_into", "sendall", "accept", "connect", "readinto",
+    "readline", "readlines", "madvise",
+}
+
+# Known mmap page-fault gather frames: functions whose subscript
+# gathers fault NVMe pages on the request thread (no call for the
+# analyzer to see). Serving-path reachability of these frames is a
+# VL502 finding unless the def line carries the justification.
+VL502_PAGEFAULT_FUNCS = (
+    ("vearch_tpu/tiering/ram_tier.py", "HostRowCache.get_rows"),
+    ("vearch_tpu/tiering/ram_tier.py", "HostRamSlabTier.get"),
+    ("vearch_tpu/tiering/readahead.py", "advise_rows"),
+)
+
+# -- VL504 deadline propagation ----------------------------------------------
+# RPC/HTTP boundary calls on the search serving path: every one must
+# thread the request deadline downstream (an explicit timeout= derived
+# from the armed RequestContext, or a body dict that carries
+# deadline_ms for the receiving node to arm its own context).
+VL504_BOUNDARY_SUFFIXES = ("cluster.rpc:call",)
+VL504_BOUNDARY_DOTTED = ("rpc.call",)
+VL504_DEADLINE_KWARGS = {"timeout", "deadline_ms", "deadline"}
+VL504_BODY_DEADLINE_KEY = "deadline_ms"

@@ -230,7 +230,7 @@ def run_paths(
     # import for side effect: rule registration
     from vearch_tpu.tools.lint import (  # noqa: F401
         rules_accounting, rules_buckets, rules_dispatch, rules_errors,
-        rules_locks, rules_obs, rules_quality,
+        rules_interproc, rules_locks, rules_obs, rules_quality,
     )
 
     active = list(rules) if rules is not None else list(RULES)
